@@ -1,0 +1,30 @@
+"""gke_ray_train_tpu — a TPU-native distributed LLM training framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of the
+``ericehanley/gke-ray-train`` reference (Ray-on-GKE LLM fine-tuning on
+A3/H100 + NCCL), rebuilt TPU-first:
+
+- SPMD over a ``jax.sharding.Mesh`` (axes: data / fsdp / model / context)
+  instead of DDP+NCCL (reference: ray-jobs/pytorch_llm_ray.py:362-364).
+- GSPMD-sharded params/optimizer state (ZeRO/FSDP as sharding specs, not
+  machinery) instead of bitsandbytes paged optimizers.
+- Functional pytree models (Llama-3 / Mistral / Gemma-2 / BasicLM) with
+  Pallas flash attention and ring attention for long context.
+- orbax sharded checkpointing with retention + resume (the reference never
+  wires resume — fine_tune_llama_ray.py has no resume_from_checkpoint).
+- A Ray Train style ``JaxTrainer`` preserving the reference's
+  ``train_loop_per_worker(config)`` API shape (fine_tune_llama_ray.py:198).
+"""
+
+__version__ = "0.1.0"
+
+from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    batch_sharding,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_CONTEXT,
+    MESH_AXES,
+)

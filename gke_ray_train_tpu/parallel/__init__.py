@@ -1,0 +1,12 @@
+from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig,
+    build_mesh,
+    batch_sharding,
+    named_sharding,
+    distributed_init,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_CONTEXT,
+    MESH_AXES,
+)

@@ -1,0 +1,200 @@
+"""Topology & distributed init — the TPU-native replacement for the
+reference's process-group bootstrap + NCCL backend.
+
+Reference behavior being replaced (see SURVEY.md §5.8):
+- Ray sets MASTER_ADDR/PORT/WORLD_SIZE/RANK and Accelerate calls
+  ``torch.distributed.init_process_group`` (narrated in the reference at
+  ray-jobs/fine_tune_llama_ray.py:413-419); NCCL is selected explicitly at
+  ray-jobs/pytorch_llm_ray.py:362-364.
+
+TPU-native design: ``jax.distributed.initialize`` performs multi-host
+rendezvous (coordinator = host 0, address supplied by the Ray trainer),
+after which there is *no communication library to manage* — collectives
+(psum / all_gather / reduce_scatter / ppermute) are emitted by GSPMD from
+sharding specs and ride ICI within a slice, DCN between slices.
+
+Mesh axes (fixed vocabulary across the framework):
+
+==========  ========================================================
+axis        what is sharded over it
+==========  ========================================================
+``data``    pure data parallelism — batch only (DCN-friendly, outermost)
+``fsdp``    batch AND params/optimizer state (ZeRO-3-style, over ICI)
+``model``   tensor parallelism — attention heads / ffn hidden
+``context`` sequence/context parallelism — ring attention over ICI
+==========  ========================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_MODEL = "model"
+AXIS_CONTEXT = "context"
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_CONTEXT)
+
+# Batch dims are sharded over both DP-like axes; this is the standard GSPMD
+# trick that makes FSDP "just a sharding spec" (SURVEY.md §2c row FSDP).
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Any axis may be -1 ("fill with what remains").
+
+    Mirrors the reference's infra-shape env vars NUM_NODES /
+    NUM_GPUS_PER_NODE (ray-jobs/fine_tune_llama_ray.py:439-441) but as a
+    4-axis logical topology instead of a flat world size.
+    """
+
+    data: int = 1
+    fsdp: int = -1
+    model: int = 1
+    context: int = 1
+    # Number of DCN-connected slices. When >1, the `data` axis is laid out
+    # across slices (DCN-outermost) via a hybrid device mesh.
+    num_slices: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        """Resolve -1 entries so the product equals ``n_devices``."""
+        sizes = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)
+                 if f.name in MESH_AXES}
+        fills = [k for k, v in sizes.items() if v == -1]
+        if len(fills) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {fills}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if fills:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot fill axis {fills[0]}: {n_devices} devices not "
+                    f"divisible by fixed product {fixed} ({sizes})")
+            sizes[fills[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} has {math.prod(sizes.values())} slots but "
+                f"{n_devices} devices are present")
+        return dataclasses.replace(self, **sizes)
+
+    @property
+    def shape(self) -> tuple:
+        return (self.data, self.fsdp, self.model, self.context)
+
+    @staticmethod
+    def from_dict(cfg: dict) -> "MeshConfig":
+        """Build from the flat UPPER_CASE config convention the reference
+        uses for fine_tune_config.json (SURVEY.md §5.6)."""
+        return MeshConfig(
+            data=int(cfg.get("MESH_DATA", 1)),
+            fsdp=int(cfg.get("MESH_FSDP", -1)),
+            model=int(cfg.get("MESH_MODEL", 1)),
+            context=int(cfg.get("MESH_CONTEXT", 1)),
+            num_slices=int(cfg.get("NUM_SLICES", 1)),
+        )
+
+
+def build_mesh(config: MeshConfig | None = None,
+               devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """Build the 4-axis device mesh.
+
+    Single-slice: ``mesh_utils.create_device_mesh`` lets JAX pick a
+    device order that maps logical neighbors onto physical ICI neighbors
+    (critical for ring attention on ``context`` and all-gathers on
+    ``fsdp``). Multi-slice: a hybrid mesh puts ``data`` across DCN.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = (config or MeshConfig()).resolve(len(devices))
+
+    if config.num_slices > 1:
+        if config.data % config.num_slices != 0:
+            raise ValueError(
+                f"data axis ({config.data}) must be divisible by "
+                f"num_slices ({config.num_slices})")
+        per_slice = (config.data // config.num_slices, config.fsdp,
+                     config.model, config.context)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            per_slice, (config.num_slices, 1, 1, 1), devices=devices)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                config.shape, devices=devices)
+        except (ValueError, NotImplementedError):
+            # Fake/CPU devices or odd topologies: plain row-major layout.
+            dev_array = np.asarray(devices).reshape(config.shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def batch_sharding(mesh: Mesh, *, context_sharded: bool = False) -> NamedSharding:
+    """Sharding for a [batch, seq, ...] array: batch over (data, fsdp),
+    optionally sequence over context (sequence parallelism)."""
+    seq = AXIS_CONTEXT if context_sharded else None
+    return NamedSharding(mesh, P(BATCH_AXES, seq))
+
+
+def _distributed_state_initialized() -> bool:
+    """True if jax.distributed.initialize already ran in this process.
+
+    Uses the distributed client handle rather than jax.process_count():
+    the latter lazily initializes the XLA backend, which would make a
+    subsequent jax.distributed.initialize raise.
+    """
+    try:
+        from jax._src import distributed as _jd
+        return _jd.global_state.client is not None
+    except Exception:
+        return False
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host rendezvous — the analogue of the reference's
+    MASTER_ADDR/MASTER_PORT + init_process_group handshake
+    (ray-jobs/fine_tune_llama_ray.py:413-418).
+
+    Arguments default from env (set by rayint.JaxTrainer on each worker):
+    ``COORDINATOR_ADDRESS`` / ``NUM_PROCESSES`` / ``PROCESS_ID``. No-op in
+    single-process mode or when already initialized.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    num_processes = num_processes if num_processes is not None else int(
+        os.environ.get("NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("PROCESS_ID", "0"))
+    if num_processes <= 1:
+        logger.info("single-process run; skipping jax.distributed.initialize")
+        return
+    if coordinator_address is None:
+        raise ValueError(
+            f"multi-process run requested (NUM_PROCESSES={num_processes}) "
+            "but no coordinator address given — set COORDINATOR_ADDRESS or "
+            "pass coordinator_address=. Refusing to degrade to "
+            f"{num_processes} independent single-process trainings.")
+    # NOTE: must not touch jax.devices()/process_count() here — any backend
+    # query initializes XLA, after which jax.distributed.initialize raises.
+    if _distributed_state_initialized():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info("jax.distributed initialized: process %d/%d, %d devices",
+                process_id, num_processes, len(jax.devices()))

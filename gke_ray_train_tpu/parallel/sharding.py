@@ -1,0 +1,39 @@
+"""Pytree sharding helpers.
+
+The reference's FSDP/ZeRO story is delegated to DDP + bitsandbytes paged
+optimizers (SURVEY.md rows D4/D5). Here sharded data-parallelism is purely
+declarative: every param pytree travels with a matching pytree of
+``PartitionSpec``; placing params/optimizer state is one ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def tree_shardings(mesh: Mesh, spec_tree: Any) -> Any:
+    """Map a pytree of PartitionSpec into a pytree of NamedSharding."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def shard_tree(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Place a (host-local) pytree onto the mesh per its spec tree."""
+    return jax.device_put(tree, tree_shardings(mesh, spec_tree))
+
+
+def constrain(x: Any, mesh: Mesh, *spec) -> Any:
+    """with_sharding_constraint under an explicit mesh."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n`` (shape padding for even
+    sharding: vocab / ffn dims must divide the model axis)."""
+    return ((n + m - 1) // m) * m

@@ -1,0 +1,52 @@
+"""Test harness: distributed-without-a-cluster (SURVEY.md §4).
+
+8 fake CPU devices let every test exercise the real mesh/pjit sharding
+specs — DP/FSDP/TP partitioning, ring-attention ppermute, checkpoint shard
+round-trips — with no TPU attached. Env vars must be set before jax import,
+hence module scope here.
+"""
+
+import os
+
+# XLA_FLAGS must land before first backend init (jax may already be
+# *imported* by a site hook that registers a TPU platform; backend init is
+# lazy, so flipping jax_platforms below still wins).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "float32")
+
+import pytest  # noqa: E402
+
+from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def dp_mesh(devices):
+    """Pure data-parallel mesh (8 data)."""
+    return build_mesh(MeshConfig(data=8, fsdp=1), devices)
+
+
+@pytest.fixture(scope="session")
+def fsdp_mesh(devices):
+    """2 data x 4 fsdp."""
+    return build_mesh(MeshConfig(data=2, fsdp=4), devices)
+
+
+@pytest.fixture(scope="session")
+def tp_mesh(devices):
+    """2 fsdp x 2 model x 2 context — every parallelism axis live."""
+    return build_mesh(MeshConfig(data=1, fsdp=2, model=2, context=2), devices)

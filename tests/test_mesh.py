@@ -1,0 +1,73 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from gke_ray_train_tpu.parallel.mesh import (
+    MeshConfig, build_mesh, batch_sharding, MESH_AXES)
+from gke_ray_train_tpu.parallel.sharding import (
+    shard_tree, tree_shardings, pad_to_multiple)
+
+
+def test_resolve_fill():
+    cfg = MeshConfig(data=2, fsdp=-1).resolve(8)
+    assert cfg.shape == (2, 4, 1, 1)
+
+
+def test_resolve_exact():
+    cfg = MeshConfig(data=1, fsdp=2, model=2, context=2).resolve(8)
+    assert cfg.shape == (1, 2, 2, 2)
+
+
+def test_resolve_errors():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=2, fsdp=2).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+def test_build_mesh_axes(fsdp_mesh):
+    assert fsdp_mesh.axis_names == MESH_AXES
+    assert fsdp_mesh.shape["data"] == 2
+    assert fsdp_mesh.shape["fsdp"] == 4
+
+
+def test_from_dict():
+    cfg = MeshConfig.from_dict({"MESH_FSDP": 4, "MESH_MODEL": 2})
+    assert cfg.fsdp == 4 and cfg.model == 2 and cfg.data == 1
+
+
+def test_batch_sharding_places_batch(fsdp_mesh):
+    x = jnp.zeros((16, 32))
+    xs = jax.device_put(x, batch_sharding(fsdp_mesh))
+    # batch axis split over data*fsdp = 8 shards
+    assert xs.addressable_shards[0].data.shape == (2, 32)
+
+
+def test_shard_tree(tp_mesh):
+    tree = {"w": jnp.zeros((8, 16)), "b": jnp.zeros((16,))}
+    specs = {"w": P("fsdp", "model"), "b": P(None)}
+    sharded = shard_tree(tree, tp_mesh, specs)
+    assert sharded["w"].addressable_shards[0].data.shape == (4, 8)
+    assert sharded["b"].addressable_shards[0].data.shape == (16,)
+
+
+def test_psum_over_mesh(dp_mesh):
+    """A real collective on the fake mesh: mean over data axis."""
+    from jax.experimental.shard_map import shard_map
+
+    def f(x):
+        return jax.lax.pmean(x, "data")
+
+    x = jnp.arange(8.0)
+    y = shard_map(f, mesh=dp_mesh,
+                  in_specs=P(("data",)), out_specs=P(("data",)))(x)
+    np.testing.assert_allclose(np.asarray(y), np.full(8, 3.5))
+
+
+def test_pad_to_multiple():
+    assert pad_to_multiple(100, 128) == 128
+    assert pad_to_multiple(256, 128) == 256
