@@ -1,0 +1,176 @@
+"""Model configuration — one config dataclass drives every model family.
+
+The reference hardcodes one bespoke torch model (BasicLLM,
+ray-jobs/pytorch_llm_ray.py:75-105) and delegates Llama to HF
+``AutoModelForCausalLM`` (ray-jobs/fine_tune_llama_ray.py:240). Here a
+single functional decoder core (models/transformer.py) covers Llama-3,
+Mistral, Gemma-2 and the from-scratch BasicLM via this config, so every
+family gets the same sharding specs, flash/ring attention, LoRA and
+checkpointing for free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    d_model: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    d_ff: int
+    head_dim: Optional[int] = None          # default d_model // n_heads
+    max_seq_len: int = 2048
+    norm_eps: float = 1e-5
+
+    # positional encoding
+    positional: str = "rope"                # "rope" | "sinusoidal"
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[dict] = None     # llama-3.1 NTK-by-parts dict
+
+    # block structure; n_layers must divide by len(block_pattern).
+    # "global" = full causal attention, "sliding" = windowed causal.
+    block_pattern: Tuple[str, ...] = ("global",)
+    sliding_window: Optional[int] = None
+
+    activation: str = "silu"                # "silu" | "gelu_tanh"
+    tie_embeddings: bool = False
+    embed_scale: bool = False               # x *= sqrt(d_model) after embed
+    norm_scale_plus_one: bool = False       # Gemma (1 + scale) RMSNorm
+    post_block_norm: bool = False           # Gemma-2 post-attn/post-mlp norms
+    attn_softcap: Optional[float] = None    # Gemma-2: 50.0
+    logit_softcap: Optional[float] = None   # Gemma-2: 30.0
+    attn_scale: Optional[float] = None      # override head_dim**-0.5
+
+    # numerics / execution
+    dtype: str = "bfloat16"                 # activation/compute dtype
+    param_dtype: str = "float32"
+    remat: bool = True                      # checkpoint each block
+    attn_impl: str = "xla"                  # "xla" | "flash" | "ring"
+
+    def __post_init__(self):
+        if self.n_layers % len(self.block_pattern) != 0:
+            raise ValueError(
+                f"n_layers={self.n_layers} not divisible by block pattern "
+                f"length {len(self.block_pattern)}")
+        if self.n_heads % self.n_kv_heads != 0:
+            raise ValueError("n_heads must be a multiple of n_kv_heads")
+
+    @property
+    def resolved_head_dim(self) -> int:
+        return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def n_repeats(self) -> int:
+        return self.n_layers // len(self.block_pattern)
+
+    def param_count(self) -> int:
+        """Exact dense param count (used for MFU math, train/metrics.py)."""
+        hd = self.resolved_head_dim
+        attn = (self.d_model * self.n_heads * hd          # wq
+                + 2 * self.d_model * self.n_kv_heads * hd  # wk, wv
+                + self.n_heads * hd * self.d_model)        # wo
+        mlp = 3 * self.d_model * self.d_ff
+        norms = 2 * self.d_model + (2 * self.d_model if self.post_block_norm
+                                    else 0)
+        per_layer = attn + mlp + norms
+        embed = self.vocab_size * self.d_model
+        head = 0 if self.tie_embeddings else self.vocab_size * self.d_model
+        return self.n_layers * per_layer + embed + head + self.d_model
+
+
+# ---------------------------------------------------------------------------
+# Family presets. Shapes follow the public architecture descriptions of each
+# model family (not any particular implementation).
+# ---------------------------------------------------------------------------
+
+_LLAMA31_SCALING = dict(factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
+                        original_max_position_embeddings=8192)
+
+
+def llama3_8b(**kw) -> ModelConfig:
+    return ModelConfig(
+        name="llama3-8b", vocab_size=128256, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=8192,
+        rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+        **kw)
+
+
+def llama3_70b(**kw) -> ModelConfig:
+    return ModelConfig(
+        name="llama3-70b", vocab_size=128256, d_model=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, d_ff=28672, max_seq_len=8192,
+        rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+        **kw)
+
+
+def mistral_7b(**kw) -> ModelConfig:
+    return ModelConfig(
+        name="mistral-7b", vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=4096,
+        rope_theta=10000.0, block_pattern=("sliding",), sliding_window=4096,
+        **kw)
+
+
+def gemma2_9b(**kw) -> ModelConfig:
+    return ModelConfig(
+        name="gemma2-9b", vocab_size=256128, d_model=3584, n_layers=42,
+        n_heads=16, n_kv_heads=8, d_ff=14336, head_dim=256, max_seq_len=8192,
+        rope_theta=10000.0, block_pattern=("sliding", "global"),
+        sliding_window=4096, activation="gelu_tanh", tie_embeddings=True,
+        embed_scale=True, norm_scale_plus_one=True, post_block_norm=True,
+        attn_softcap=50.0, logit_softcap=30.0,
+        attn_scale=(3584 // 16) ** -0.5,  # query_pre_attn_scalar = d/heads
+        norm_eps=1e-6,
+        **kw)
+
+
+def basic_lm(vocab_size: int, *, d_model: int = 2048, n_layers: int = 24,
+             n_heads: int = 16, d_ff: int = 8192, max_seq_len: int = 1024,
+             **kw) -> ModelConfig:
+    """The from-scratch pre-train model — capability parity with the
+    reference's ~1.2B BasicLLM (2048d/24L/16H/8192ff,
+    ray-jobs/pytorch_llm_ray.py:328-332), TPU-redesigned: pre-LN RMSNorm +
+    RoPE decoder rather than post-LN sinusoidal nn.TransformerEncoder."""
+    return ModelConfig(
+        name="basic-lm", vocab_size=vocab_size, d_model=d_model,
+        n_layers=n_layers, n_heads=n_heads, n_kv_heads=n_heads, d_ff=d_ff,
+        max_seq_len=max_seq_len, **kw)
+
+
+def tiny(vocab_size: int = 256, **kw) -> ModelConfig:
+    """Test-scale config (fits the 8-fake-device CPU mesh)."""
+    defaults = dict(
+        name="tiny", vocab_size=vocab_size, d_model=64, n_layers=2,
+        n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=128,
+        dtype="float32", param_dtype="float32", remat=False)
+    defaults.update(kw)
+    return ModelConfig(**defaults)
+
+
+PRESETS = {
+    "llama3-8b": llama3_8b,
+    "llama3-70b": llama3_70b,
+    "mistral-7b": mistral_7b,
+    "gemma2-9b": gemma2_9b,
+}
+
+
+def preset_for_model_id(model_id: str, **kw) -> ModelConfig:
+    """Map an HF-style MODEL_ID (fine_tune_config.json key) to a preset."""
+    mid = model_id.lower()
+    if "llama-3" in mid and "70b" in mid:
+        return llama3_70b(**kw)
+    if "llama" in mid:
+        return llama3_8b(**kw)
+    if "mistral" in mid:
+        return mistral_7b(**kw)
+    if "gemma-2" in mid or "gemma2" in mid:
+        return gemma2_9b(**kw)
+    raise ValueError(f"no preset for MODEL_ID={model_id!r}; "
+                     f"known families: {sorted(PRESETS)}")

@@ -1,0 +1,232 @@
+"""The shared decoder-only transformer core (all model families).
+
+Functional, pytree-first: ``init_params`` builds the weights,
+``param_specs`` builds the matching PartitionSpec tree, ``forward`` is a
+pure jittable function. Layers are *stacked* ([n_repeats, ...] leading dim)
+and iterated with ``lax.scan`` so a 32-80 layer model traces/compiles one
+block body instead of unrolling — the XLA-idiomatic replacement for the
+reference's python ``nn.TransformerEncoder`` module stack
+(ray-jobs/pytorch_llm_ray.py:86-90).
+
+Sharding (SURVEY.md §2c, TPU build disposition):
+- FSDP: every matrix's d_model-ish dim sharded over ``fsdp``.
+- TP: head / ffn-hidden dims sharded over ``model``.
+- Activations: batch over (data, fsdp), sequence over ``context``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.ops.attention import (
+    dot_product_attention, make_attention_mask)
+from gke_ray_train_tpu.ops.norms import rms_norm
+from gke_ray_train_tpu.ops.rope import (
+    apply_rope, rope_frequencies, sinusoidal_positions)
+from gke_ray_train_tpu.parallel.mesh import AXIS_CONTEXT, BATCH_AXES
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
+    """Initialize the stacked param pytree.
+
+    Truncated-normal fan-in style init; the two residual-writing matrices
+    (wo, w_down) are scaled down by 1/sqrt(2*n_layers) to keep the
+    residual-stream variance flat at depth.
+    """
+    pdt = jnp.dtype(cfg.param_dtype)
+    hd = cfg.resolved_head_dim
+    D, F, H, K, R = (cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.n_kv_heads,
+                     cfg.n_repeats)
+    depth_scale = 1.0 / math.sqrt(2 * cfg.n_layers)
+
+    keys = iter(jax.random.split(key, 16 * len(cfg.block_pattern) + 4))
+
+    def normal(shape, std):
+        return (jax.random.truncated_normal(next(keys), -3, 3, shape,
+                                            jnp.float32) * std).astype(pdt)
+
+    def block_params():
+        std = 0.02
+        p = {
+            "attn_norm": jnp.zeros((R, D), pdt) if cfg.norm_scale_plus_one
+            else jnp.ones((R, D), pdt),
+            "wq": normal((R, D, H * hd), std),
+            "wk": normal((R, D, K * hd), std),
+            "wv": normal((R, D, K * hd), std),
+            "wo": normal((R, H * hd, D), std * depth_scale),
+            "mlp_norm": jnp.zeros((R, D), pdt) if cfg.norm_scale_plus_one
+            else jnp.ones((R, D), pdt),
+            "w_gate": normal((R, D, F), std),
+            "w_up": normal((R, D, F), std),
+            "w_down": normal((R, F, D), std * depth_scale),
+        }
+        if cfg.post_block_norm:
+            zero_or_one = (jnp.zeros if cfg.norm_scale_plus_one else jnp.ones)
+            p["attn_post_norm"] = zero_or_one((R, D), pdt)
+            p["mlp_post_norm"] = zero_or_one((R, D), pdt)
+        return p
+
+    params: Params = {
+        "embed": normal((cfg.vocab_size, D), 0.02),
+        "blocks": [block_params() for _ in cfg.block_pattern],
+        "final_norm": (jnp.zeros((D,), pdt) if cfg.norm_scale_plus_one
+                       else jnp.ones((D,), pdt)),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal((D, cfg.vocab_size), 0.02)
+    return params
+
+
+def param_specs(cfg: ModelConfig) -> Params:
+    """PartitionSpec tree matching init_params exactly.
+
+    The ZeRO/FSDP sharding the reference gets from bitsandbytes+DDP
+    (SURVEY.md rows D4/D5) is this table; nothing else.
+    """
+    def block_specs():
+        s = {
+            "attn_norm": P(None, None),
+            "wq": P(None, "fsdp", "model"),
+            "wk": P(None, "fsdp", "model"),
+            "wv": P(None, "fsdp", "model"),
+            "wo": P(None, "model", "fsdp"),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, "fsdp", "model"),
+            "w_up": P(None, "fsdp", "model"),
+            "w_down": P(None, "model", "fsdp"),
+        }
+        if cfg.post_block_norm:
+            s["attn_post_norm"] = P(None, None)
+            s["mlp_post_norm"] = P(None, None)
+        return s
+
+    specs: Params = {
+        "embed": P("model", "fsdp"),
+        "blocks": [block_specs() for _ in cfg.block_pattern],
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("fsdp", "model")
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _constrain(x, mesh: Optional[Mesh], *spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def _mlp(x, lp, cfg: ModelConfig, dtype):
+    gate = jnp.einsum("bsd,df->bsf", x, lp["w_gate"].astype(dtype))
+    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"].astype(dtype))
+    if cfg.activation == "silu":
+        act = jax.nn.silu(gate)
+    elif cfg.activation == "gelu_tanh":
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        raise ValueError(f"unknown activation {cfg.activation}")
+    return jnp.einsum("bsf,fd->bsd", act * up, lp["w_down"].astype(dtype))
+
+
+def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh):
+    B, S, D = x.shape
+    hd = cfg.resolved_head_dim
+    H, K = cfg.n_heads, cfg.n_kv_heads
+    q = jnp.einsum("bsd,dh->bsh", x, lp["wq"].astype(dtype))
+    k = jnp.einsum("bsd,dh->bsh", x, lp["wk"].astype(dtype))
+    v = jnp.einsum("bsd,dh->bsh", x, lp["wv"].astype(dtype))
+    q = q.reshape(B, S, H, hd)
+    k = k.reshape(B, S, K, hd)
+    v = v.reshape(B, S, K, hd)
+    q = _constrain(q, mesh, BATCH_AXES, AXIS_CONTEXT, "model", None)
+    k = _constrain(k, mesh, BATCH_AXES, AXIS_CONTEXT, "model", None)
+    if rope is not None:
+        q = apply_rope(q, positions, rope)
+        k = apply_rope(k, positions, rope)
+    out = dot_product_attention(
+        q, k, v, mask, scale=cfg.attn_scale, logit_softcap=cfg.attn_softcap)
+    out = out.reshape(B, S, H * hd)
+    return jnp.einsum("bsh,hd->bsd", out, lp["wo"].astype(dtype))
+
+
+def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
+            positions: Optional[jnp.ndarray] = None,
+            segment_ids: Optional[jnp.ndarray] = None,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+    B, S = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
+
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+    x = params["embed"].astype(dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype)
+    if cfg.positional == "sinusoidal":
+        table = jnp.asarray(sinusoidal_positions(cfg.max_seq_len, cfg.d_model))
+        x = x + table.astype(dtype)[positions]
+        rope = None
+    else:
+        rope = jnp.asarray(rope_frequencies(
+            cfg.resolved_head_dim, theta=cfg.rope_theta,
+            llama3_scaling=cfg.rope_scaling))
+    x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+
+    # masks are shared by every layer of the same kind — build once
+    masks = {}
+    for kind in set(cfg.block_pattern):
+        masks[kind] = make_attention_mask(
+            positions, positions, segment_ids, segment_ids, causal=True,
+            sliding_window=cfg.sliding_window if kind == "sliding" else None)
+
+    def repeat_body(x, layer_slice):
+        for p, kind in enumerate(cfg.block_pattern):
+            lp = layer_slice[p]
+            h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
+            h = _attn(h, lp, cfg, dtype, rope, positions, masks[kind], mesh)
+            if cfg.post_block_norm:
+                h = rms_norm(h, lp["attn_post_norm"], eps=eps,
+                             scale_plus_one=sp1)
+            x = x + h
+            x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+            h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
+            h = _mlp(h, lp, cfg, dtype)
+            if cfg.post_block_norm:
+                h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
+                             scale_plus_one=sp1)
+            x = x + h
+            x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+        return x, None
+
+    body = repeat_body
+    if cfg.remat:
+        body = jax.checkpoint(repeat_body, prevent_cse=False)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"], eps=eps, scale_plus_one=sp1)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dtype),
+                        preferred_element_type=jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    logits = _constrain(logits, mesh, BATCH_AXES, AXIS_CONTEXT, "model")
+    return logits

@@ -1,0 +1,85 @@
+"""Attention — the XLA reference implementation.
+
+Replaces the reference's dense-mask ``nn.TransformerEncoder`` attention
+(ray-jobs/pytorch_llm_ray.py:91-99, O(L²) materialized mask, no GQA) and
+the HF Llama attention used by the fine-tune path. Design notes:
+
+- GQA-native: query heads are grouped over KV heads with einsum — no
+  materialized repeat of K/V (MXU-friendly, saves HBM).
+- The mask is built from *segment IDs* (sequence packing, SURVEY.md §5.7)
+  + causality + optional sliding window; logits are computed in fp32.
+- Gemma-2 style attn softcap supported.
+- This is the semantics oracle: the Pallas flash kernel
+  (ops/flash_attention.py) and ring attention (ops/ring_attention.py) are
+  tested against it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from einops import rearrange
+
+NEG_INF = -2.0e38  # fp32-safe large negative (avoid actual -inf in softmax)
+
+
+def make_attention_mask(q_positions: jnp.ndarray,
+                        kv_positions: jnp.ndarray,
+                        q_segment_ids: Optional[jnp.ndarray] = None,
+                        kv_segment_ids: Optional[jnp.ndarray] = None,
+                        *,
+                        causal: bool = True,
+                        sliding_window: Optional[int] = None) -> jnp.ndarray:
+    """Boolean mask [batch, q_len, kv_len] (True = attend).
+
+    positions: [batch, len] absolute token positions (ring attention passes
+    shifted slices here). segment_ids: [batch, len]; tokens attend only
+    within their own segment — this is what replaces the reference's
+    GROUP_BY_LENGTH batching trick with proper packed-sequence masking.
+    """
+    q_pos = q_positions[:, :, None]
+    kv_pos = kv_positions[:, None, :]
+    mask = jnp.ones(q_pos.shape[:2] + (kv_pos.shape[-1],), dtype=bool)
+    if causal:
+        mask &= kv_pos <= q_pos
+    if sliding_window is not None:
+        mask &= kv_pos > q_pos - sliding_window
+    if q_segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None else q_segment_ids
+        mask &= q_segment_ids[:, :, None] == kv_seg[:, None, :]
+        # segment id 0 = padding: padding keys are never attended. Fully
+        # masked padding *rows* are safe: dot_product_attention's softmax
+        # degrades to uniform (never NaN) and the loss masks those tokens.
+        mask &= kv_seg[:, None, :] != 0
+    return mask
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None,
+                          *,
+                          scale: Optional[float] = None,
+                          logit_softcap: Optional[float] = None) -> jnp.ndarray:
+    """GQA attention.
+
+    q: [B, S, H, dh]; k, v: [B, T, K, dh] with H % K == 0.
+    mask: [B, S, T] boolean, True = attend. Returns [B, S, H, dh].
+    Softmax in fp32; output cast back to q.dtype.
+    """
+    B, S, H, dh = q.shape
+    K = k.shape[2]
+    G = H // K
+    scale = dh ** -0.5 if scale is None else scale
+
+    qg = rearrange(q, "b s (k g) d -> b s k g d", k=K, g=G)
+    logits = jnp.einsum("bskgd,btkd->bkgst", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logit_softcap is not None:
+        logits = jnp.tanh(logits / logit_softcap) * logit_softcap
+    if mask is not None:
+        logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
+    probs = jnp.exp(logits - jnp.max(logits, axis=-1, keepdims=True))
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return rearrange(out, "b s k g d -> b s (k g) d").astype(q.dtype)

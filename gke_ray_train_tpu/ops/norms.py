@@ -1,0 +1,29 @@
+"""Normalization ops.
+
+RMSNorm in fp32 regardless of compute dtype — the variance accumulation is
+precision-sensitive and the cost is negligible (fused by XLA into the
+surrounding elementwise chain; no Pallas needed for a bandwidth-bound op
+XLA already fuses).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, *, eps: float = 1e-5,
+             scale_plus_one: bool = False) -> jnp.ndarray:
+    """y = x / rms(x) * scale, computed in fp32, cast back to x.dtype.
+
+    ``scale_plus_one``: Gemma-style ``(1 + scale)`` parameterization
+    (weights stored as an offset from identity).
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    s = scale.astype(jnp.float32)
+    if scale_plus_one:
+        s = 1.0 + s
+    return (y * s).astype(dtype)

@@ -1,0 +1,70 @@
+"""Positional encodings: RoPE (Llama/Mistral/Gemma families, with the
+Llama-3.1 frequency-scaling scheme) and classic sinusoidal tables (the
+BasicLM pre-train path — capability parity with the reference's
+PositionalEncoding, ray-jobs/pytorch_llm_ray.py:57-73, re-designed as a
+pure function instead of a module buffer).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(head_dim: int, *, theta: float = 10000.0,
+                     llama3_scaling: Optional[dict] = None) -> np.ndarray:
+    """Inverse frequencies [head_dim//2], fp32, host-computed once.
+
+    ``llama3_scaling``: dict with factor / low_freq_factor /
+    high_freq_factor / original_max_position_embeddings implementing the
+    Llama-3.1 NTK-by-parts rescale.
+    """
+    freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                             / head_dim))
+    if llama3_scaling:
+        factor = llama3_scaling["factor"]
+        low = llama3_scaling["low_freq_factor"]
+        high = llama3_scaling["high_freq_factor"]
+        orig = llama3_scaling["original_max_position_embeddings"]
+        wavelen = 2.0 * np.pi / freqs
+        # three bands: high-freq kept, low-freq divided by factor,
+        # middle band smoothly interpolated
+        smooth = np.clip((orig / wavelen - low) / (high - low), 0.0, 1.0)
+        interpolated = (1.0 - smooth) * freqs / factor + smooth * freqs
+        freqs = np.where(wavelen < orig / high, freqs,           # high freq
+                         np.where(wavelen > orig / low,
+                                  freqs / factor,                 # low freq
+                                  interpolated))                  # middle
+    return freqs.astype(np.float32)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray,
+               inv_freqs: jnp.ndarray) -> jnp.ndarray:
+    """Rotate q or k. x: [..., seq, heads, head_dim]; positions: [..., seq].
+
+    Uses the split-halves convention (first half real, second half imag) —
+    the same layout HF Llama uses, so imported weights need no permutation.
+    Computed in fp32, cast back.
+    """
+    dtype = x.dtype
+    angles = positions[..., :, None].astype(jnp.float32) * inv_freqs  # [..., S, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., S, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(dtype)
+
+
+def sinusoidal_positions(max_len: int, d_model: int) -> np.ndarray:
+    """Classic transformer sinusoidal PE table [max_len, d_model], fp32."""
+    pos = np.arange(max_len, dtype=np.float64)[:, None]
+    div = np.exp(np.arange(0, d_model, 2, dtype=np.float64)
+                 * (-np.log(10000.0) / d_model))
+    table = np.zeros((max_len, d_model), dtype=np.float64)
+    table[:, 0::2] = np.sin(pos * div)
+    table[:, 1::2] = np.cos(pos * div[: d_model // 2])
+    return table.astype(np.float32)

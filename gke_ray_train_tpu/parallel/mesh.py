@@ -75,6 +75,9 @@ class MeshConfig:
         fills = [k for k, v in sizes.items() if v == -1]
         if len(fills) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {fills}")
+        bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+        if bad:
+            raise ValueError(f"mesh axis sizes must be >=1 (or -1): {bad}")
         fixed = math.prod(v for v in sizes.values() if v != -1)
         if fills:
             if n_devices % fixed != 0:
@@ -181,6 +184,8 @@ def distributed_init(coordinator_address: Optional[str] = None,
     if num_processes <= 1:
         logger.info("single-process run; skipping jax.distributed.initialize")
         return
+    if _distributed_state_initialized():  # no-op if a launcher already did it
+        return
     if coordinator_address is None:
         raise ValueError(
             f"multi-process run requested (NUM_PROCESSES={num_processes}) "
@@ -189,8 +194,6 @@ def distributed_init(coordinator_address: Optional[str] = None,
             f"{num_processes} independent single-process trainings.")
     # NOTE: must not touch jax.devices()/process_count() here — any backend
     # query initializes XLA, after which jax.distributed.initialize raises.
-    if _distributed_state_initialized():
-        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,192 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.models import (
+    ModelConfig, tiny, init_params, param_specs, forward, gemma2_9b,
+    llama3_8b, preset_for_model_id)
+from gke_ray_train_tpu.ops.attention import (
+    dot_product_attention, make_attention_mask)
+from gke_ray_train_tpu.ops.rope import (
+    apply_rope, rope_frequencies, sinusoidal_positions)
+from gke_ray_train_tpu.parallel.sharding import shard_tree
+
+
+def test_specs_match_params():
+    cfg = tiny()
+    params = init_params(cfg, jax.random.key(0))
+    specs = param_specs(cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(
+        specs, is_leaf=lambda x: not isinstance(x, (dict, list)))
+    # every spec rank matches its leaf rank
+    for p, s in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(specs, is_leaf=lambda x: not isinstance(
+                        x, (dict, list)))):
+        assert len(s) == p.ndim, (p.shape, s)
+
+
+def test_param_count_matches():
+    cfg = tiny()
+    params = init_params(cfg, jax.random.key(0))
+    actual = sum(p.size for p in jax.tree.leaves(params))
+    assert actual == cfg.param_count()
+
+
+def test_param_count_gemma_tied():
+    cfg = tiny(tie_embeddings=True, post_block_norm=True,
+               norm_scale_plus_one=True)
+    params = init_params(cfg, jax.random.key(0))
+    actual = sum(p.size for p in jax.tree.leaves(params))
+    assert actual == cfg.param_count()
+
+
+def test_forward_shapes_and_finite():
+    cfg = tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_forward_causality():
+    """Changing a future token must not change past logits."""
+    cfg = tiny()
+    params = init_params(cfg, jax.random.key(0))
+    t1 = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab_size)
+    t2 = t1.at[0, 10].set((t1[0, 10] + 1) % cfg.vocab_size)
+    l1 = forward(params, t1, cfg)
+    l2 = forward(params, t2, cfg)
+    np.testing.assert_allclose(np.asarray(l1[0, :10]), np.asarray(l2[0, :10]),
+                               atol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, 10:]), np.asarray(l2[0, 10:]))
+
+
+def test_segment_isolation():
+    """Packed segments must not attend across segment boundaries."""
+    cfg = tiny()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (1, 16), 0, cfg.vocab_size)
+    seg = jnp.asarray([[1] * 8 + [2] * 8])
+    pos = jnp.asarray([list(range(8)) + list(range(8))])
+    # perturb a token in segment 1; segment 2 logits must be unchanged
+    tokens2 = tokens.at[0, 3].set((tokens[0, 3] + 1) % cfg.vocab_size)
+    l1 = forward(params, tokens, cfg, positions=pos, segment_ids=seg)
+    l2 = forward(params, tokens2, cfg, positions=pos, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(l1[0, 8:]), np.asarray(l2[0, 8:]),
+                               atol=1e-5)
+
+
+def test_sliding_window_mask():
+    pos = jnp.arange(8)[None, :]
+    m = make_attention_mask(pos, pos, causal=True, sliding_window=3)
+    m = np.asarray(m[0])
+    assert m[5, 5] and m[5, 4] and m[5, 3]
+    assert not m[5, 2] and not m[5, 6]
+
+
+def test_gqa_matches_mha_when_repeated():
+    """GQA with repeated KV == full MHA attention."""
+    key = jax.random.key(0)
+    B, S, H, K, dh = 2, 8, 4, 2, 16
+    q = jax.random.normal(key, (B, S, H, dh))
+    k = jax.random.normal(jax.random.key(1), (B, S, K, dh))
+    v = jax.random.normal(jax.random.key(2), (B, S, K, dh))
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+    mask = make_attention_mask(pos, pos)
+    out_gqa = dot_product_attention(q, k, v, mask)
+    k_full = jnp.repeat(k, H // K, axis=2)
+    v_full = jnp.repeat(v, H // K, axis=2)
+    out_mha = dot_product_attention(q, k_full, v_full, mask)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha),
+                               atol=1e-5)
+
+
+def test_attention_vs_jax_reference():
+    """Our attention == jax.nn.dot_product_attention on the causal case."""
+    B, S, H, dh = 2, 8, 4, 16
+    q = jax.random.normal(jax.random.key(0), (B, S, H, dh))
+    k = jax.random.normal(jax.random.key(1), (B, S, H, dh))
+    v = jax.random.normal(jax.random.key(2), (B, S, H, dh))
+    pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+    ours = dot_product_attention(q, k, v, make_attention_mask(pos, pos))
+    ref = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref), atol=1e-5)
+
+
+def test_rope_rotation_property():
+    """RoPE: relative rotation — <rope(q,m), rope(k,n)> depends on m-n."""
+    hd = 16
+    freqs = rope_frequencies(hd)
+    q = jax.random.normal(jax.random.key(0), (1, 1, 1, hd))
+    k = jax.random.normal(jax.random.key(1), (1, 1, 1, hd))
+
+    def score(m, n):
+        qm = apply_rope(q, jnp.asarray([[m]]), freqs)
+        kn = apply_rope(k, jnp.asarray([[n]]), freqs)
+        return float(jnp.sum(qm * kn))
+
+    assert score(3, 1) == pytest.approx(score(7, 5), abs=1e-4)
+    assert score(3, 1) != pytest.approx(score(3, 2), abs=1e-4)
+
+
+def test_llama3_rope_scaling_bands():
+    freqs_plain = rope_frequencies(64, theta=500000.0)
+    freqs_scaled = rope_frequencies(
+        64, theta=500000.0,
+        llama3_scaling=dict(factor=8.0, low_freq_factor=1.0,
+                            high_freq_factor=4.0,
+                            original_max_position_embeddings=8192))
+    # highest frequency untouched, lowest divided by ~factor
+    assert freqs_scaled[0] == pytest.approx(freqs_plain[0])
+    assert freqs_scaled[-1] == pytest.approx(freqs_plain[-1] / 8.0, rel=1e-5)
+
+
+def test_sinusoidal_table():
+    t = sinusoidal_positions(16, 8)
+    assert t.shape == (16, 8)
+    np.testing.assert_allclose(t[0], [0, 1, 0, 1, 0, 1, 0, 1], atol=1e-6)
+
+
+def test_gemma2_tiny_forward():
+    """Gemma-2 structural features all at once: sliding/global alternation,
+    post norms, softcaps, tied embeddings."""
+    cfg = tiny(tie_embeddings=True, post_block_norm=True,
+               norm_scale_plus_one=True, attn_softcap=50.0,
+               logit_softcap=30.0, block_pattern=("sliding", "global"),
+               sliding_window=4, embed_scale=True)
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert float(jnp.max(jnp.abs(logits))) <= 30.0
+    assert "lm_head" not in params
+
+
+def test_sharded_forward_all_axes(tp_mesh):
+    """Full forward with params actually sharded over fsdp+model+context."""
+    cfg = tiny(n_heads=4, n_kv_heads=2)
+    params = init_params(cfg, jax.random.key(0))
+    sharded = shard_tree(params, tp_mesh, param_specs(cfg))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+
+    ref = forward(params, tokens, cfg)
+    out = jax.jit(lambda p, t: forward(p, t, cfg, mesh=tp_mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+
+def test_preset_lookup():
+    assert preset_for_model_id("meta-llama/Llama-3.1-8B-Instruct").name == \
+        "llama3-8b"
+    assert preset_for_model_id("mistralai/Mistral-7B-v0.3").name == "mistral-7b"
+    assert preset_for_model_id("google/gemma-2-9b-it").name == "gemma2-9b"
+    with pytest.raises(ValueError):
+        preset_for_model_id("bert-base")
+
+
+def test_big_config_param_counts():
+    assert llama3_8b().param_count() == pytest.approx(8.03e9, rel=0.02)
+    assert gemma2_9b().param_count() == pytest.approx(9.2e9, rel=0.05)
