@@ -1,0 +1,122 @@
+"""Benchmark — one JSON line for the driver.
+
+Measures sustained training throughput (tokens/sec/chip) and MFU on the
+attached accelerator(s) for the flagship-architecture model at the
+largest size that fits comfortably, using the real jitted train step
+(loss+grad+clip+adamw, bf16 compute). Timing uses block_until_ready
+around a multi-step window (the tunneled TPU dispatches asynchronously;
+per-step host timings are meaningless).
+
+vs_baseline: ratio against the reference's *published* numbers — the
+reference publishes none (BASELINE.md), so the recorded baseline is this
+framework's own first-light number on this hardware (BASELINE.md table);
+vs_baseline=1.0 marks the establishing run and later rounds report their
+speedup against it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    import dataclasses
+
+    from gke_ray_train_tpu.models import llama3_8b
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.train import (
+        ThroughputMeter, make_optimizer, make_train_state, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+
+    # Llama-3 architecture; dims scaled to the attached hardware. On one
+    # v5e chip (16 GB HBM): fp32 params + fp32 adam mu/nu = 12 bytes/param
+    # → ~0.7B params leaves room for bf16 activations at B=8, S=1024.
+    if on_tpu:
+        size = dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+                    d_ff=5504, vocab_size=32768)
+        B, S, steps = 8, 1024, 20
+    else:  # CPU smoke fallback so the bench always emits a line
+        size = dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_ff=512, vocab_size=2048)
+        B, S, steps = max(4, n_dev), 256, 3
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True, **size)
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1), devices)
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
+
+    batch = {
+        "inputs": jax.random.randint(jax.random.key(1), (B, S), 0,
+                                     cfg.vocab_size),
+        "targets": jax.random.randint(jax.random.key(2), (B, S), 0,
+                                      cfg.vocab_size),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+    from gke_ray_train_tpu.train.step import batch_shardings
+    batch = jax.device_put(batch, batch_shardings(mesh))
+
+    # warmup/compile
+    state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = B * S * steps
+    tps_chip = tokens / dt / n_dev
+    meter = ThroughputMeter(cfg, seq_len=S, n_devices=n_dev)
+    mfu = (tokens / dt) * train_flops_per_token(cfg, S) / (
+        meter.peak_flops * n_dev)
+
+    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "bench_baseline.json")
+    baseline = None
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                recorded = json.load(f)
+            if recorded.get("device_kind") == devices[0].device_kind:
+                baseline = float(recorded["tokens_per_sec_per_chip"])
+        except (OSError, ValueError, KeyError):
+            pass
+
+    result = {
+        "metric": "tokens/sec/chip llama3-arch causal-LM train step "
+                  f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, bf16, "
+                  f"{devices[0].device_kind} x{n_dev})",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tps_chip / baseline, 3) if baseline else 1.0,
+        "mfu": round(mfu, 4),
+        "loss": round(float(jax.device_get(m["loss"])), 4),
+    }
+    print(json.dumps(result))
+
+    if baseline is None and on_tpu:
+        with open(baseline_path, "w") as f:
+            json.dump({"device_kind": devices[0].device_kind,
+                       "tokens_per_sec_per_chip": tps_chip,
+                       "mfu": mfu}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from gke_ray_train_tpu.ckpt.manager import CheckpointManager  # noqa: F401
+from gke_ray_train_tpu.ckpt.hf_io import (  # noqa: F401
+    load_hf_checkpoint, save_hf_checkpoint)

@@ -1,0 +1,189 @@
+"""HF-format weight interop (safetensors, torch-free).
+
+Replaces ``AutoModelForCausalLM.from_pretrained``
+(ray-jobs/fine_tune_llama_ray.py:240) for loading pretrained Llama /
+Mistral / Gemma-2 weights into the sharded pytree, and ``save_pretrained``
+(:354-355, :373-374) for exporting — final artifacts stay in HF
+safetensors layout for ecosystem parity (SURVEY.md §5.4).
+
+Implementation notes:
+- ``safetensors.safe_open`` streams one tensor at a time (never the whole
+  model) and each tensor is ``device_put`` straight into its target
+  sharding — hosts keep at most one full tensor in RAM (SURVEY.md §7
+  "hard parts" #1).
+- torch Linear stores W as [out, in]; our layout is [in, out] → transpose
+  on both directions. Embeddings and norm scales copy as-is. HF Gemma-2
+  RMSNorm uses the same (1 + w) convention as ``norm_scale_plus_one``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import (
+    Params, init_params, param_specs)
+from gke_ray_train_tpu.parallel.sharding import tree_shardings
+
+
+def _hf_layer_names(cfg: ModelConfig, i: int) -> Dict[str, str]:
+    """our-key → HF tensor name for decoder layer i."""
+    base = f"model.layers.{i}"
+    names = {
+        "wq": f"{base}.self_attn.q_proj.weight",
+        "wk": f"{base}.self_attn.k_proj.weight",
+        "wv": f"{base}.self_attn.v_proj.weight",
+        "wo": f"{base}.self_attn.o_proj.weight",
+        "w_gate": f"{base}.mlp.gate_proj.weight",
+        "w_up": f"{base}.mlp.up_proj.weight",
+        "w_down": f"{base}.mlp.down_proj.weight",
+        "attn_norm": f"{base}.input_layernorm.weight",
+    }
+    if cfg.post_block_norm:  # Gemma-2 has four norms per block
+        names["attn_post_norm"] = f"{base}.post_attention_layernorm.weight"
+        names["mlp_norm"] = f"{base}.pre_feedforward_layernorm.weight"
+        names["mlp_post_norm"] = f"{base}.post_feedforward_layernorm.weight"
+    else:
+        names["mlp_norm"] = f"{base}.post_attention_layernorm.weight"
+    return names
+
+_TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def _open_shards(model_dir: str):
+    """Yield (name → (file, tensorname)) index over all safetensors shards."""
+    from safetensors import safe_open
+
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    files: Dict[str, str] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            weight_map = json.load(f)["weight_map"]
+        for tname, fname in weight_map.items():
+            files[tname] = os.path.join(model_dir, fname)
+    else:
+        single = os.path.join(model_dir, "model.safetensors")
+        if not os.path.exists(single):
+            raise FileNotFoundError(
+                f"no model.safetensors[.index.json] in {model_dir}")
+        with safe_open(single, framework="numpy") as f:
+            for tname in f.keys():
+                files[tname] = single
+    return files
+
+
+def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
+                       mesh=None) -> Params:
+    """Stream HF safetensors into the (optionally mesh-sharded) pytree."""
+    from safetensors import safe_open
+
+    files = _open_shards(model_dir)
+    shardings = (tree_shardings(mesh, param_specs(cfg))
+                 if mesh is not None else None)
+    pdt = jnp.dtype(cfg.param_dtype)
+    P_ = len(cfg.block_pattern)
+    R = cfg.n_repeats
+    handles: Dict[str, object] = {}
+
+    def read(tname: str) -> np.ndarray:
+        path = files[tname]
+        if path not in handles:
+            handles[path] = safe_open(path, framework="numpy")
+        # bf16 tensors come back as ml_dtypes.bfloat16, which jnp converts
+        return np.asarray(handles[path].get_tensor(tname))
+
+    def place(arr: np.ndarray, spec_path) -> jax.Array:
+        arr = jnp.asarray(arr, pdt)
+        if shardings is None:
+            return arr
+        return jax.device_put(arr, spec_path)
+
+    # per-(pattern-position, key): gather the R per-layer tensors, stack
+    blocks = []
+    for p in range(P_):
+        blk: Dict[str, jax.Array] = {}
+        keys = _hf_layer_names(cfg, 0).keys()
+        for key in keys:
+            stacked = np.stack([
+                _maybe_t(read(_hf_layer_names(cfg, r * P_ + p)[key]), key)
+                for r in range(R)])
+            tgt = shardings["blocks"][p][key] if shardings is not None else None
+            blk[key] = place(stacked, tgt)
+        blocks.append(blk)
+
+    params: Params = {
+        "embed": place(read("model.embed_tokens.weight"),
+                       shardings["embed"] if shardings else None),
+        "blocks": blocks,
+        "final_norm": place(read("model.norm.weight"),
+                            shardings["final_norm"] if shardings else None),
+    }
+    if not cfg.tie_embeddings:
+        name = ("lm_head.weight" if "lm_head.weight" in files
+                else "model.embed_tokens.weight")  # some exports tie anyway
+        params["lm_head"] = place(read(name).T,
+                                  shardings["lm_head"] if shardings else None)
+    for h in handles.values():
+        del h
+    return params
+
+
+def _maybe_t(arr: np.ndarray, key: str) -> np.ndarray:
+    return arr.T if key in _TRANSPOSED else arr
+
+
+def save_hf_checkpoint(params: Params, cfg: ModelConfig, out_dir: str,
+                       *, dtype: str = "bfloat16") -> None:
+    """Export the pytree to single-file HF safetensors + minimal
+    config.json (save_pretrained parity)."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    P_ = len(cfg.block_pattern)
+    out_np: Dict[str, np.ndarray] = {}
+
+    def to_np(x) -> np.ndarray:
+        arr = np.asarray(jax.device_get(x))
+        if dtype == "bfloat16":
+            import ml_dtypes
+            arr = arr.astype(ml_dtypes.bfloat16)
+        else:
+            arr = arr.astype(np.dtype(dtype))
+        # astype(order='K') keeps F-order on transposed views and
+        # safetensors serializes the raw buffer ignoring strides — force C
+        return np.ascontiguousarray(arr)
+
+    out_np["model.embed_tokens.weight"] = to_np(params["embed"])
+    out_np["model.norm.weight"] = to_np(params["final_norm"])
+    if not cfg.tie_embeddings:
+        out_np["lm_head.weight"] = to_np(params["lm_head"].T)
+    for p, blk in enumerate(params["blocks"]):
+        for r in range(cfg.n_repeats):
+            names = _hf_layer_names(cfg, r * P_ + p)
+            for key, tname in names.items():
+                arr = jax.device_get(blk[key][r])
+                out_np[tname] = to_np(_maybe_t(np.asarray(arr), key))
+    save_file(out_np, os.path.join(out_dir, "model.safetensors"))
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump({
+            "architectures": ["GkeRayTrainTpuForCausalLM"],
+            "model_family": cfg.name,
+            "vocab_size": cfg.vocab_size,
+            "hidden_size": cfg.d_model,
+            "num_hidden_layers": cfg.n_layers,
+            "num_attention_heads": cfg.n_heads,
+            "num_key_value_heads": cfg.n_kv_heads,
+            "intermediate_size": cfg.d_ff,
+            "head_dim": cfg.resolved_head_dim,
+            "rope_theta": cfg.rope_theta,
+            "rms_norm_eps": cfg.norm_eps,
+            "tie_word_embeddings": cfg.tie_embeddings,
+            "torch_dtype": dtype,
+        }, f, indent=2)

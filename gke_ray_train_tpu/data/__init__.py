@@ -1,0 +1,11 @@
+from gke_ray_train_tpu.data.tokenizer import (  # noqa: F401
+    CharTokenizer, ByteTokenizer, load_hf_tokenizer,
+    PAD_ID, BOS_ID, EOS_ID, UNK_ID)
+from gke_ray_train_tpu.data.lm_dataset import (  # noqa: F401
+    SlidingWindowDataset, ShardedBatches)
+from gke_ray_train_tpu.data.sft import (  # noqa: F401
+    format_gretel_sql_example, render_chat, tokenize_sft_example, downsample,
+    pad_sft_rows, sft_epoch_batches, synthetic_sql_rows)
+from gke_ray_train_tpu.data.packing import (  # noqa: F401
+    pack_examples, batch_packed)
+from gke_ray_train_tpu.data.prepare import prepare_wikitext2  # noqa: F401

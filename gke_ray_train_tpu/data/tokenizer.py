@@ -1,0 +1,99 @@
+"""Tokenizers.
+
+CharTokenizer is capability parity with the reference's
+(ray-jobs/pytorch_llm_ray.py:20-55): fit char↔id vocab on raw text,
+encode/decode, JSON save/load. Ids 0..3 are reserved so segment-id /
+padding conventions hold everywhere (the reference has no pad token and
+relies on drop_last batching; we make padding explicit).
+
+HF tokenizers (Llama etc.) are loaded lazily through ``transformers`` —
+only the tokenizer, never torch model code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+UNK_ID = 3
+_RESERVED = {PAD_ID: "<pad>", BOS_ID: "<bos>", EOS_ID: "<eos>",
+             UNK_ID: "<unk>"}
+
+
+class CharTokenizer:
+    """Character-level tokenizer for the from-scratch pre-train path."""
+
+    def __init__(self, stoi: Optional[Dict[str, int]] = None):
+        self.stoi: Dict[str, int] = dict(stoi or {})
+        self.itos: Dict[int, str] = {i: s for s, i in self.stoi.items()}
+
+    @classmethod
+    def fit(cls, text: str) -> "CharTokenizer":
+        chars = sorted(set(text))
+        stoi = {ch: i + len(_RESERVED) for i, ch in enumerate(chars)}
+        return cls(stoi)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.stoi) + len(_RESERVED)
+
+    def encode(self, text: str) -> np.ndarray:
+        return np.fromiter((self.stoi.get(ch, UNK_ID) for ch in text),
+                           dtype=np.int32, count=len(text))
+
+    def decode(self, ids) -> str:
+        return "".join(self.itos.get(int(i), "") for i in ids
+                       if int(i) not in _RESERVED)
+
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"stoi": self.stoi}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "CharTokenizer":
+        with open(path) as f:
+            return cls(json.load(f)["stoi"])
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer (vocab 256 + reserved ids) — the offline /
+    smoke-test stand-in for an HF tokenizer: same call surface
+    (``__call__ → {"input_ids"}``, ``decode``, ``eos_token_id``)."""
+
+    chat_template = None
+    eos_token_id = EOS_ID
+    pad_token_id = PAD_ID
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(_RESERVED)
+
+    def __call__(self, text: str, add_special_tokens: bool = False):
+        ids = [b + len(_RESERVED) for b in text.encode("utf-8")]
+        return {"input_ids": ids}
+
+    def encode(self, text: str) -> List[int]:
+        return self(text)["input_ids"]
+
+    def decode(self, ids) -> str:
+        bs = bytes(int(i) - len(_RESERVED) for i in ids
+                   if int(i) >= len(_RESERVED))
+        return bs.decode("utf-8", errors="replace")
+
+
+def load_hf_tokenizer(model_id: str, hf_token: Optional[str] = None):
+    """Replacement for AutoTokenizer.from_pretrained at
+    ray-jobs/fine_tune_llama_ray.py:207-209 (incl. pad-token fixup)."""
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(model_id, token=hf_token)
+    if tok.pad_token is None:
+        tok.pad_token = tok.eos_token
+    return tok

@@ -1,0 +1,101 @@
+"""Side-by-side base-vs-tuned inference comparison (SURVEY.md §3.4).
+
+Capability parity with run_inference_comparison
+(ray-jobs/fine_tune_llama_ray.py:22-194): host-0-only, post-training;
+filter test rows, greedy-generate from both the original and the
+fine-tuned weights with a shared prompt template, print and accumulate
+side-by-side results, JSON-dump to shared storage. TPU redesign: both
+models generate through one jitted greedy loop (models/decode.py); no
+device cache juggling (the reference's del model +
+torch.cuda.empty_cache() dance at :191-194 has no XLA equivalent — arrays
+free when references drop).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from gke_ray_train_tpu.data.sft import format_gretel_sql_example, render_chat
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.decode import greedy_generate
+from gke_ray_train_tpu.models.transformer import Params
+
+logger = logging.getLogger(__name__)
+
+
+def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
+                    prompt_text: str, *, max_new_tokens: int = 300,
+                    lora: Optional[Params] = None,
+                    lora_scale: float = 1.0) -> str:
+    ids = np.asarray(
+        tokenizer(prompt_text, add_special_tokens=False)["input_ids"],
+        np.int32)
+    # fixed-size buffer: prompt + generation room (jit compiles per shape
+    # bucket; production callers share one bucket via max_seq budgeting)
+    L = min(len(ids) + max_new_tokens, cfg.max_seq_len)
+    ids = ids[-(L - max_new_tokens):] if len(ids) > L - max_new_tokens else ids
+    buf = np.zeros((1, L), np.int32)
+    buf[0, :len(ids)] = ids
+    eos_ids = []
+    if getattr(tokenizer, "eos_token_id", None) is not None:
+        eos_ids.append(int(tokenizer.eos_token_id))
+    out = greedy_generate(params, jnp.asarray(buf),
+                          jnp.asarray([len(ids)], jnp.int32), cfg,
+                          max_new_tokens=max_new_tokens,
+                          eos_ids=tuple(eos_ids),
+                          lora=lora, lora_scale=lora_scale)
+    out = np.asarray(out[0])
+    gen = out[len(ids):]
+    gen = gen[gen != 0]
+    if eos_ids:
+        stops = np.where(np.isin(gen, eos_ids))[0]
+        if len(stops):
+            gen = gen[: stops[0]]
+    return tokenizer.decode(gen)
+
+
+def run_inference_comparison(
+        base_params: Params, tuned_params: Params, cfg: ModelConfig,
+        tokenizer, test_rows: List[Dict], *,
+        num_samples: int = 2, max_new_tokens: int = 300,
+        output_path: Optional[str] = None,
+        row_filter: Optional[Callable[[Dict], bool]] = None,
+        format_example: Callable = format_gretel_sql_example) -> List[Dict]:
+    """Returns the accumulated comparison records; writes JSON when
+    ``output_path`` is given (reference behavior: filter on
+    sql_complexity == 'window functions', :87-96; JSON dump :182-187)."""
+    if row_filter is not None:
+        test_rows = [r for r in test_rows if row_filter(r)]
+    test_rows = test_rows[:num_samples]
+    results = []
+    for i, row in enumerate(test_rows):
+        msgs = format_example(row)
+        prompt = render_chat(tokenizer, msgs, add_generation_prompt=True)
+        record = {
+            "index": i,
+            "question": msgs["user"],
+            "reference_answer": msgs["assistant"],
+            "base_model_answer": generate_answer(
+                base_params, cfg, tokenizer, prompt,
+                max_new_tokens=max_new_tokens),
+            "finetuned_model_answer": generate_answer(
+                tuned_params, cfg, tokenizer, prompt,
+                max_new_tokens=max_new_tokens),
+        }
+        logger.info("sample %d\n  Q: %s\n  base: %s\n  tuned: %s", i,
+                    record["question"], record["base_model_answer"],
+                    record["finetuned_model_answer"])
+        results.append(record)
+    if output_path:
+        os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
+        with open(output_path, "w") as f:
+            json.dump(results, f, indent=2)
+        logger.info("wrote %d comparison records to %s", len(results),
+                    output_path)
+    return results

@@ -31,7 +31,9 @@ class ModelConfig:
     # positional encoding
     positional: str = "rope"                # "rope" | "sinusoidal"
     rope_theta: float = 10000.0
-    rope_scaling: Optional[dict] = None     # llama-3.1 NTK-by-parts dict
+    # llama-3.1 NTK-by-parts params; dicts are normalized to sorted
+    # (key, value) tuples in __post_init__ so the config stays hashable
+    rope_scaling: Optional[object] = None
 
     # block structure; n_layers must divide by len(block_pattern).
     # "global" = full causal attention, "sliding" = windowed causal.
@@ -54,12 +56,29 @@ class ModelConfig:
     attn_impl: str = "xla"                  # "xla" | "flash" | "ring"
 
     def __post_init__(self):
+        # keep the config hashable (jit static arg): dicts → sorted tuples
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(self, "rope_scaling",
+                               tuple(sorted(self.rope_scaling.items())))
+        if isinstance(self.block_pattern, list):
+            object.__setattr__(self, "block_pattern",
+                               tuple(self.block_pattern))
         if self.n_layers % len(self.block_pattern) != 0:
             raise ValueError(
                 f"n_layers={self.n_layers} not divisible by block pattern "
                 f"length {len(self.block_pattern)}")
         if self.n_heads % self.n_kv_heads != 0:
             raise ValueError("n_heads must be a multiple of n_kv_heads")
+        unknown = set(self.block_pattern) - {"global", "sliding"}
+        if unknown:
+            raise ValueError(f"unknown block kinds {unknown}; "
+                             "valid: global, sliding")
+        if "sliding" in self.block_pattern and self.sliding_window is None:
+            raise ValueError("block_pattern contains 'sliding' but "
+                             "sliding_window is None — that would silently "
+                             "run full global attention")
+        if self.attn_impl not in ("xla", "flash", "ring"):
+            raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
 
     @property
     def resolved_head_dim(self) -> int:
@@ -94,24 +113,29 @@ _LLAMA31_SCALING = dict(factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
 
 
 def llama3_8b(**kw) -> ModelConfig:
+    kw.setdefault("rope_scaling", _LLAMA31_SCALING)
     return ModelConfig(
         name="llama3-8b", vocab_size=128256, d_model=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=8192,
-        rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+        rope_theta=500000.0,
         **kw)
 
 
 def llama3_70b(**kw) -> ModelConfig:
+    kw.setdefault("rope_scaling", _LLAMA31_SCALING)
     return ModelConfig(
         name="llama3-70b", vocab_size=128256, d_model=8192, n_layers=80,
         n_heads=64, n_kv_heads=8, d_ff=28672, max_seq_len=8192,
-        rope_theta=500000.0, rope_scaling=_LLAMA31_SCALING,
+        rope_theta=500000.0,
         **kw)
 
 
 def mistral_7b(**kw) -> ModelConfig:
+    # vocab 32768 = the extended v0.3 tokenizer; pass vocab_size=32000 for
+    # v0.1/v0.2 checkpoints
+    kw.setdefault("vocab_size", 32768)
     return ModelConfig(
-        name="mistral-7b", vocab_size=32000, d_model=4096, n_layers=32,
+        name="mistral-7b", d_model=4096, n_layers=32,
         n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=4096,
         rope_theta=10000.0, block_pattern=("sliding",), sliding_window=4096,
         **kw)
@@ -125,7 +149,7 @@ def gemma2_9b(**kw) -> ModelConfig:
         sliding_window=4096, activation="gelu_tanh", tie_embeddings=True,
         embed_scale=True, norm_scale_plus_one=True, post_block_norm=True,
         attn_softcap=50.0, logit_softcap=30.0,
-        attn_scale=(3584 // 16) ** -0.5,  # query_pre_attn_scalar = d/heads
+        attn_scale=256 ** -0.5,  # 9B query_pre_attn_scalar = head_dim = 256
         norm_eps=1e-6,
         **kw)
 
@@ -164,11 +188,16 @@ PRESETS = {
 def preset_for_model_id(model_id: str, **kw) -> ModelConfig:
     """Map an HF-style MODEL_ID (fine_tune_config.json key) to a preset."""
     mid = model_id.lower()
-    if "llama-3" in mid and "70b" in mid:
-        return llama3_70b(**kw)
-    if "llama" in mid:
-        return llama3_8b(**kw)
+    is_31 = any(t in mid for t in ("llama-3.1", "llama-3_1", "llama3.1"))
+    if "llama-3" in mid or "llama3" in mid:
+        fn = llama3_70b if "70b" in mid else llama3_8b
+        # NTK rope scaling is a Llama-3.1 feature; plain Llama-3
+        # checkpoints were trained without it
+        kw.setdefault("rope_scaling", _LLAMA31_SCALING if is_31 else None)
+        return fn(**kw)
     if "mistral" in mid:
+        if any(t in mid for t in ("v0.1", "v0.2")):
+            kw.setdefault("vocab_size", 32000)
         return mistral_7b(**kw)
     if "gemma-2" in mid or "gemma2" in mid:
         return gemma2_9b(**kw)

@@ -1,0 +1,64 @@
+"""Greedy decoding.
+
+Parity with the reference's inference path
+(generate_sql_with_chat_template, ray-jobs/fine_tune_llama_ray.py:120-149:
+greedy ``model.generate(max_new_tokens, do_sample=False)`` with multiple
+EOS ids). TPU design: one jitted step over a *fixed-size* token buffer
+(no dynamic shapes — recompilation-free), with a lax.while_loop host-free
+decode loop. KV-cache decode is a planned optimization; this full-forward
+variant is the correctness oracle for it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import Params, forward
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "eos_ids",
+                                   "lora_scale"))
+def greedy_generate(params: Params, prompt: jnp.ndarray,
+                    prompt_len: jnp.ndarray, cfg: ModelConfig, *,
+                    max_new_tokens: int = 64,
+                    eos_ids: Sequence[int] = (),
+                    lora: Optional[Params] = None,
+                    lora_scale: float = 1.0) -> jnp.ndarray:
+    """prompt: [B, L] int32 padded buffer with room for generation
+    (L >= max(prompt_len) + max_new_tokens); prompt_len: [B] int32.
+
+    Returns the buffer with generated tokens written after each prompt.
+    Finished rows (EOS emitted) stop growing.
+    """
+    B, L = prompt.shape
+    eos = jnp.asarray(list(eos_ids) or [-1], jnp.int32)
+
+    def cond(state):
+        buf, lens, done, step = state
+        return (step < max_new_tokens) & ~jnp.all(done)
+
+    def body(state):
+        buf, lens, done, step = state
+        logits = forward(params, buf, cfg, lora=lora, lora_scale=lora_scale)
+        # next token comes from the logit at each row's current last token
+        idx = jnp.clip(lens - 1, 0, L - 1)
+        next_tok = jnp.argmax(
+            jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0, :],
+            axis=-1).astype(jnp.int32)
+        write_pos = jnp.clip(lens, 0, L - 1)
+        buf = jnp.where(
+            (~done)[:, None] & (jnp.arange(L)[None, :] == write_pos[:, None]),
+            next_tok[:, None], buf)
+        now_eos = jnp.any(next_tok[:, None] == eos[None, :], axis=-1)
+        new_lens = jnp.where(done | (lens >= L), lens, lens + 1)
+        return buf, new_lens, done | now_eos | (new_lens >= L), step + 1
+
+    buf, lens, done, _ = jax.lax.while_loop(
+        cond, body, (prompt, prompt_len,
+                     jnp.zeros((B,), bool), jnp.asarray(0)))
+    return buf

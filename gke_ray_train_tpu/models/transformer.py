@@ -133,25 +133,50 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def _mlp(x, lp, cfg: ModelConfig, dtype):
-    gate = jnp.einsum("bsd,df->bsf", x, lp["w_gate"].astype(dtype))
-    up = jnp.einsum("bsd,df->bsf", x, lp["w_up"].astype(dtype))
+def _proj(x, w, lora_p, lora_scale, dtype):
+    """x @ w, plus the low-rank LoRA bypass when adapters are present.
+
+    The LoRA path is two small matmuls (never a materialized delta-W) —
+    the TPU-native replacement for peft's adapter modules (reference:
+    ray-jobs/fine_tune_llama_ray.py:245-252, SURVEY.md row D6).
+    """
+    y = jnp.einsum("bsd,dh->bsh", x, w.astype(dtype))
+    if lora_p is not None:
+        xa = jnp.einsum("bsd,dr->bsr", x, lora_p["a"].astype(dtype))
+        y = y + jnp.einsum("bsr,rh->bsh", xa, lora_p["b"].astype(dtype)) \
+            * jnp.asarray(lora_scale, dtype)
+    return y
+
+
+def _lora_entry(lora_p, name):
+    return None if lora_p is None or name not in lora_p else lora_p[name]
+
+
+def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0):
+    def lr(name):
+        return _lora_entry(lora_p, name)
+    gate = _proj(x, lp["w_gate"], lr("w_gate"), lora_scale, dtype)
+    up = _proj(x, lp["w_up"], lr("w_up"), lora_scale, dtype)
     if cfg.activation == "silu":
         act = jax.nn.silu(gate)
     elif cfg.activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
         raise ValueError(f"unknown activation {cfg.activation}")
-    return jnp.einsum("bsf,fd->bsd", act * up, lp["w_down"].astype(dtype))
+    return _proj(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype)
 
 
-def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh):
+def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh,
+          lora_p=None, lora_scale=1.0):
     B, S, D = x.shape
     hd = cfg.resolved_head_dim
     H, K = cfg.n_heads, cfg.n_kv_heads
-    q = jnp.einsum("bsd,dh->bsh", x, lp["wq"].astype(dtype))
-    k = jnp.einsum("bsd,dh->bsh", x, lp["wk"].astype(dtype))
-    v = jnp.einsum("bsd,dh->bsh", x, lp["wv"].astype(dtype))
+
+    def lr(name):
+        return _lora_entry(lora_p, name)
+    q = _proj(x, lp["wq"], lr("wq"), lora_scale, dtype)
+    k = _proj(x, lp["wk"], lr("wk"), lora_scale, dtype)
+    v = _proj(x, lp["wv"], lr("wv"), lora_scale, dtype)
     q = q.reshape(B, S, H, hd)
     k = k.reshape(B, S, K, hd)
     v = v.reshape(B, S, K, hd)
@@ -160,17 +185,32 @@ def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh):
     if rope is not None:
         q = apply_rope(q, positions, rope)
         k = apply_rope(k, positions, rope)
-    out = dot_product_attention(
-        q, k, v, mask, scale=cfg.attn_scale, logit_softcap=cfg.attn_softcap)
+    if cfg.attn_impl == "xla":
+        out = dot_product_attention(
+            q, k, v, mask, scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_softcap)
+    else:
+        # flash (pallas) and ring (context-parallel) kernels plug in here
+        from gke_ray_train_tpu.ops.dispatch import attention_dispatch
+        out = attention_dispatch(cfg.attn_impl, q, k, v, mask,
+                                 scale=cfg.attn_scale,
+                                 logit_softcap=cfg.attn_softcap, mesh=mesh)
     out = out.reshape(B, S, H * hd)
-    return jnp.einsum("bsh,hd->bsd", out, lp["wo"].astype(dtype))
+    return _proj(out, lp["wo"], lr("wo"), lora_scale, dtype)
 
 
 def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             positions: Optional[jnp.ndarray] = None,
             segment_ids: Optional[jnp.ndarray] = None,
-            mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """tokens [B, S] int32 → logits [B, S, vocab] float32."""
+            mesh: Optional[Mesh] = None,
+            lora: Optional[Params] = None,
+            lora_scale: float = 1.0) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, vocab] float32.
+
+    ``lora``: optional adapter pytree from train/lora.py (same block
+    structure as params, leaves {"a","b"}); base weights stay frozen —
+    the caller decides what is trainable via the grad argnum/mask.
+    """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
     eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
@@ -198,18 +238,22 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             positions, positions, segment_ids, segment_ids, causal=True,
             sliding_window=cfg.sliding_window if kind == "sliding" else None)
 
-    def repeat_body(x, layer_slice):
+    def repeat_body(x, xs_slice):
+        layer_slice = xs_slice[0]
+        lora_slice = xs_slice[1] if len(xs_slice) > 1 else None
         for p, kind in enumerate(cfg.block_pattern):
             lp = layer_slice[p]
+            lo = lora_slice[p] if lora_slice is not None else None
             h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
-            h = _attn(h, lp, cfg, dtype, rope, positions, masks[kind], mesh)
+            h = _attn(h, lp, cfg, dtype, rope, positions, masks[kind], mesh,
+                      lora_p=lo, lora_scale=lora_scale)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["attn_post_norm"], eps=eps,
                              scale_plus_one=sp1)
             x = x + h
             x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
             h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            h = _mlp(h, lp, cfg, dtype)
+            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
                              scale_plus_one=sp1)
@@ -220,7 +264,9 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     body = repeat_body
     if cfg.remat:
         body = jax.checkpoint(repeat_body, prevent_cse=False)
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    xs = (params["blocks"],) if lora is None else (
+        params["blocks"], lora["blocks"])
+    x, _ = jax.lax.scan(body, x, xs)
 
     x = rms_norm(x, params["final_norm"], eps=eps, scale_plus_one=sp1)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])

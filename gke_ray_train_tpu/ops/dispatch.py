@@ -1,0 +1,26 @@
+"""Attention implementation dispatch (cfg.attn_impl).
+
+"xla" is handled inline in the transformer; this module routes the
+accelerated paths so the model code never imports kernels directly.
+"""
+
+from __future__ import annotations
+
+
+def attention_dispatch(impl: str, q, k, v, mask, *, scale=None,
+                       logit_softcap=None, mesh=None):
+    if impl == "flash":
+        try:
+            from gke_ray_train_tpu.ops.flash_attention import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                "attn_impl='flash' requested but the Pallas kernel is not "
+                "available in this build") from e
+        return flash_attention(q, k, v, mask, scale=scale,
+                               logit_softcap=logit_softcap)
+    if impl == "ring":
+        raise NotImplementedError(
+            "attn_impl='ring' goes through forward(..., segment_ids/"
+            "positions) with a context-sharded mesh; ring attention is "
+            "wired at the ops/ring_attention.py level")
+    raise ValueError(f"unknown attn_impl {impl!r}")

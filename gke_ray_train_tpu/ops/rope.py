@@ -23,6 +23,8 @@ def rope_frequencies(head_dim: int, *, theta: float = 10000.0,
     """
     freqs = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
                              / head_dim))
+    if llama3_scaling is not None and not isinstance(llama3_scaling, dict):
+        llama3_scaling = dict(llama3_scaling)  # (k, v) tuple form
     if llama3_scaling:
         factor = llama3_scaling["factor"]
         low = llama3_scaling["low_freq_factor"]

@@ -1,0 +1,53 @@
+"""Worker train context — the ``ray.train.get_context()`` /
+``train.report`` surface the reference's worker fns rely on
+(ray-jobs/fine_tune_llama_ray.py:201-202, pytorch_llm_ray.py:125-128,
+:309-310), reimplemented so the same worker-fn shape runs under Ray
+actors, plain multi-process SPMD, or a single local process.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TrainContext:
+    def __init__(self):
+        self.last_reported: Optional[dict] = None
+
+    def get_world_size(self) -> int:
+        return int(os.environ.get("NUM_PROCESSES", "1"))
+
+    def get_world_rank(self) -> int:
+        return int(os.environ.get("PROCESS_ID", "0"))
+
+    def get_local_rank(self) -> int:
+        return 0  # one JAX process per host owns all local chips
+
+    def is_host0(self) -> bool:
+        return self.get_world_rank() == 0
+
+    def report(self, metrics: dict, checkpoint_path: Optional[str] = None):
+        """train.report parity: metrics become the trainer Result. Unlike
+        Ray Train this is not a barrier — collective synchronization
+        belongs to the collectives themselves (orbax save / psum), not to
+        the metrics channel."""
+        self.last_reported = dict(metrics)
+        if checkpoint_path:
+            self.last_reported["checkpoint_path"] = checkpoint_path
+        if self.is_host0():
+            logger.info("report: %s", self.last_reported)
+
+
+_context = TrainContext()
+
+
+def get_context() -> TrainContext:
+    return _context
+
+
+def report(metrics: dict, checkpoint_path: Optional[str] = None) -> None:
+    _context.report(metrics, checkpoint_path)

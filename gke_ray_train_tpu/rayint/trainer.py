@@ -1,0 +1,154 @@
+"""JaxTrainer — Ray Train style orchestration for JAX-on-TPU workers.
+
+API parity with the reference's driver blocks
+(ray-jobs/fine_tune_llama_ray.py:445-457, pytorch_llm_ray.py:346-376):
+``JaxTrainer(train_loop_per_worker, train_loop_config, scaling_config,
+run_config).fit() → Result(metrics)``. Differences, by design
+(SURVEY.md row D1):
+
+- One worker per TPU *host* (``resources_per_worker={"TPU": chips}``),
+  not per accelerator: a single JAX process drives all local chips.
+- Instead of MASTER_ADDR/PORT + NCCL process groups, the trainer elects
+  worker 0's node as the JAX coordinator and injects
+  COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID; workers then call
+  ``parallel.mesh.distributed_init`` (SURVEY.md row D2/§5.8).
+- ``FailureConfig(max_failures=N)`` is actually wired (the reference
+  never configures it, §5.3); retried workers resume from the latest
+  orbax checkpoint because every entry script restores-if-present.
+
+Ray is optional at import time: with no Ray installed (or
+``use_ray=False``) the trainer degrades to a single in-process worker —
+that is also the unit-test path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - exercised only on clusters with Ray installed
+    import ray
+    _HAS_RAY = True
+except ImportError:
+    ray = None
+    _HAS_RAY = False
+
+COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """ScalingConfig parity (fine_tune_llama_ray.py:445-449) with TPU
+    resources instead of {"GPU": 1}."""
+    num_workers: int = 1
+    resources_per_worker: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"TPU": 4})
+    placement_strategy: str = "SPREAD"
+
+    @staticmethod
+    def from_env() -> "ScalingConfig":
+        """World shape from env — NUM_HOSTS/CHIPS_PER_HOST, the TPU
+        analogues of NUM_NODES/NUM_GPUS_PER_NODE
+        (fine_tune_llama_ray.py:439-441, SURVEY.md §5.6)."""
+        hosts = int(os.environ.get("NUM_HOSTS",
+                                   os.environ.get("NUM_NODES", "1")))
+        chips = int(os.environ.get("CHIPS_PER_HOST",
+                                   os.environ.get("NUM_GPUS_PER_NODE", "4")))
+        return ScalingConfig(num_workers=hosts,
+                             resources_per_worker={"TPU": chips})
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = "jax-train"
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Dict[str, Any]
+    error: Optional[str] = None
+
+
+def _run_worker(fn: Callable, config: dict, env: Dict[str, str]):
+    os.environ.update(env)
+    from gke_ray_train_tpu.rayint.context import get_context
+    ret = fn(config)
+    reported = get_context().last_reported
+    return ret if ret is not None else (reported or {})
+
+
+class JaxTrainer:
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 use_ray: Optional[bool] = None):
+        self.fn = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.use_ray = (_HAS_RAY and self.scaling.num_workers >= 1
+                        if use_ray is None else use_ray)
+
+    # -- local ---------------------------------------------------------
+    def _fit_local(self) -> Result:
+        env = {"NUM_PROCESSES": "1", "PROCESS_ID": "0"}
+        metrics = _run_worker(self.fn, self.config, env)
+        return Result(metrics=metrics)
+
+    # -- ray ----------------------------------------------------------
+    def _fit_ray(self) -> Result:  # pragma: no cover - needs a cluster
+        if not ray.is_initialized():
+            ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
+        n = self.scaling.num_workers
+        resources = dict(self.scaling.resources_per_worker)
+
+        @ray.remote(max_restarts=0)
+        class Worker:
+            def node_ip(self):
+                return ray.util.get_node_ip_address()
+
+            def run(self, fn, config, env):
+                return _run_worker(fn, config, env)
+
+        workers = [
+            Worker.options(resources=resources,
+                           num_cpus=resources.get("CPU", 1)).remote()
+            for _ in range(n)]
+        coord_ip = ray.get(workers[0].node_ip.remote())
+        env_base = {
+            "COORDINATOR_ADDRESS": f"{coord_ip}:{COORDINATOR_PORT}",
+            "NUM_PROCESSES": str(n),
+        }
+        futures = [
+            w.run.remote(self.fn, self.config,
+                         {**env_base, "PROCESS_ID": str(i)})
+            for i, w in enumerate(workers)]
+        results = ray.get(futures)
+        return Result(metrics=results[0])
+
+    def fit(self) -> Result:
+        attempts = self.run_config.failure_config.max_failures + 1
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                if self.use_ray:
+                    return self._fit_ray()
+                return self._fit_local()
+            except Exception as e:  # noqa: BLE001 - retry-with-resume path
+                last_err = e
+                logger.exception(
+                    "training attempt %d/%d failed", attempt + 1, attempts)
+        return Result(metrics={}, error=str(last_err))

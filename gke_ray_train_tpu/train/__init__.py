@@ -1,0 +1,9 @@
+from gke_ray_train_tpu.train.optim import (  # noqa: F401
+    warmup_cosine_schedule, make_optimizer, default_weight_decay_mask)
+from gke_ray_train_tpu.train.step import (  # noqa: F401
+    TrainState, make_train_state, make_train_step, make_eval_step,
+    token_nll, batch_shardings)
+from gke_ray_train_tpu.train.lora import (  # noqa: F401
+    LoraConfig, init_lora, lora_specs, merge_lora)
+from gke_ray_train_tpu.train.metrics import (  # noqa: F401
+    ThroughputMeter, train_flops_per_token, peak_flops_per_device)

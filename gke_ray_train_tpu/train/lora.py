@@ -1,0 +1,115 @@
+"""LoRA as a low-rank param pytree (SURVEY.md row D6).
+
+The reference delegates adapters to peft (``LoraConfig`` targeting all
+projection matrices, ray-jobs/fine_tune_llama_ray.py:245-252; merge via
+``merge_and_unload`` at :349-353). Here an adapter is a second pytree with
+the same block structure as the model params; only it is passed to the
+optimizer in LoRA mode, and merging is one einsum per target at save time:
+``W += (alpha/r) * A @ B``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import Params
+
+# Default targets = every projection matrix, matching the reference config
+# LORA_TARGET_MODULES (fine_tune_config.json:33: all q/k/v/o/gate/up/down).
+ALL_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    r: int = 64
+    alpha: int = 16
+    targets: Tuple[str, ...] = ALL_TARGETS
+    # dropout on the adapter input (reference LORA_DROPOUT). Applied by the
+    # train step when an rng is provided; inference/merge ignore it.
+    dropout: float = 0.0
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.r
+
+    @staticmethod
+    def from_dict(cfg: dict) -> "LoraConfig":
+        """From reference-style flat config keys (fine_tune_config.json:30-33)."""
+        return LoraConfig(
+            r=int(cfg.get("LORA_R", 64)),
+            alpha=int(cfg.get("LORA_ALPHA", 16)),
+            dropout=float(cfg.get("LORA_DROPOUT", 0.0)),
+        )
+
+
+def _target_shapes(cfg: ModelConfig) -> dict:
+    hd = cfg.resolved_head_dim
+    return {
+        "wq": (cfg.d_model, cfg.n_heads * hd),
+        "wk": (cfg.d_model, cfg.n_kv_heads * hd),
+        "wv": (cfg.d_model, cfg.n_kv_heads * hd),
+        "wo": (cfg.n_heads * hd, cfg.d_model),
+        "w_gate": (cfg.d_model, cfg.d_ff),
+        "w_up": (cfg.d_model, cfg.d_ff),
+        "w_down": (cfg.d_ff, cfg.d_model),
+    }
+
+
+def init_lora(cfg: ModelConfig, lora_cfg: LoraConfig, key: jax.Array) -> Params:
+    """A ~ N(0, 1/r) (kaiming-ish), B = 0 — adapters start as identity."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    shapes = _target_shapes(cfg)
+    R = cfg.n_repeats
+    keys = iter(jax.random.split(key, len(cfg.block_pattern)
+                                 * len(lora_cfg.targets) + 1))
+
+    def block():
+        out = {}
+        for t in lora_cfg.targets:
+            d_in, d_out = shapes[t]
+            out[t] = {
+                "a": (jax.random.normal(next(keys), (R, d_in, lora_cfg.r),
+                                        jnp.float32)
+                      / jnp.sqrt(lora_cfg.r)).astype(pdt),
+                "b": jnp.zeros((R, lora_cfg.r, d_out), pdt),
+            }
+        return out
+
+    return {"blocks": [block() for _ in cfg.block_pattern]}
+
+
+def lora_specs(cfg: ModelConfig, lora_cfg: LoraConfig) -> Params:
+    """Adapters are small: keep the rank dim replicated, shard the long dim
+    the same way the base matrix shards (fsdp on d_model-ish inputs,
+    model on head/ffn outputs)."""
+    in_spec = {"wq": "fsdp", "wk": "fsdp", "wv": "fsdp", "wo": "model",
+               "w_gate": "fsdp", "w_up": "fsdp", "w_down": "model"}
+    out_spec = {"wq": "model", "wk": "model", "wv": "model", "wo": "fsdp",
+                "w_gate": "model", "w_up": "model", "w_down": "fsdp"}
+
+    def block():
+        return {t: {"a": P(None, in_spec[t], None),
+                    "b": P(None, None, out_spec[t])}
+                for t in lora_cfg.targets}
+
+    return {"blocks": [block() for _ in cfg.block_pattern]}
+
+
+def merge_lora(params: Params, lora: Params, lora_cfg: LoraConfig) -> Params:
+    """W + (alpha/r) A@B for every adapted matrix — the equivalent of
+    peft's merge_and_unload (reference fine_tune_llama_ray.py:349-353),
+    but a pure function on pytrees (jit/shard friendly)."""
+    merged = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+    for p_blk, l_blk in zip(merged["blocks"], lora["blocks"]):
+        for t, ab in l_blk.items():
+            delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32),
+                               ab["b"].astype(jnp.float32)) * lora_cfg.scale
+            p_blk[t] = (p_blk[t].astype(jnp.float32) + delta).astype(
+                p_blk[t].dtype)
+    return merged

@@ -1,0 +1,85 @@
+"""Throughput & MFU accounting — first-class, not derived offline.
+
+The reference logs only loss/epoch/LR/step (ray-jobs/pytorch_llm_ray.py:
+287-292) and publishes no perf numbers (BASELINE.md); tokens/sec/chip and
+MFU are this framework's north-star metrics (BASELINE.json) so they are
+computed in the loop from the model's exact FLOP count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import jax
+
+from gke_ray_train_tpu.models.config import ModelConfig
+
+# Peak dense bf16 TFLOP/s per chip, by device_kind substring.
+PEAK_FLOPS = {
+    "v5 lite": 197e12,   # v5e (jax device_kind "TPU v5 lite")
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,        # v5p reports "TPU v5"
+    "v4": 275e12,
+    "v6 lite": 918e12,   # trillium
+    "v6e": 918e12,
+    "cpu": 1e12,         # nominal, keeps MFU finite in smoke tests
+}
+
+
+def peak_flops_per_device(default: float = 197e12) -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for k, v in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if k in kind:
+            return v
+    return default
+
+
+def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
+    """6*N_params for the dense matmuls (fwd 2N + bwd 4N) plus the
+    attention term 12 * n_layers * d_attn * seq (QK^T and AV, fwd+bwd),
+    halved for causal masking."""
+    n = cfg.param_count()
+    d_attn = cfg.n_heads * cfg.resolved_head_dim
+    attn = 12 * cfg.n_layers * d_attn * seq_len * 0.5
+    return 6.0 * n + attn
+
+
+@dataclasses.dataclass
+class ThroughputMeter:
+    """Wall-clock tokens/sec/chip + MFU over a sliding window of steps."""
+    cfg: ModelConfig
+    seq_len: int
+    n_devices: int
+    peak_flops: Optional[float] = None
+    _t0: float = dataclasses.field(default_factory=time.perf_counter)
+    _tokens: float = 0.0
+    _steps: int = 0
+
+    def __post_init__(self):
+        if self.peak_flops is None:
+            self.peak_flops = peak_flops_per_device()
+
+    def update(self, tokens_this_step: float) -> None:
+        self._tokens += float(tokens_this_step)
+        self._steps += 1
+
+    def reset(self) -> None:
+        self._t0 = time.perf_counter()
+        self._tokens = 0.0
+        self._steps = 0
+
+    def snapshot(self) -> dict:
+        dt = max(time.perf_counter() - self._t0, 1e-9)
+        tps = self._tokens / dt
+        tps_chip = tps / max(self.n_devices, 1)
+        flops = tps * train_flops_per_token(self.cfg, self.seq_len)
+        mfu = flops / (self.peak_flops * max(self.n_devices, 1))
+        return {
+            "tokens_per_sec": tps,
+            "tokens_per_sec_per_chip": tps_chip,
+            "mfu": mfu,
+            "steps_per_sec": self._steps / dt,
+        }

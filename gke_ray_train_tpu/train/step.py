@@ -1,0 +1,228 @@
+"""The jitted train step — the visible, hackable hot loop.
+
+This is the TPU re-design of the only training loop whose internals the
+reference exposes (ray-jobs/pytorch_llm_ray.py:270-284: zero_grad →
+forward → CrossEntropyLoss(flattened) → backward (DDP all-reduce) →
+clip_grad_norm(1.0) → step → sched.step), plus the grad-accumulation the
+fine-tune path gets from HF Trainer (fine_tune_config.json:14).
+
+TPU-first differences:
+- One jitted function does microbatch scan + loss + grad + clip + update;
+  gradient sync is *implicit* — GSPMD inserts the psum/reduce-scatter the
+  sharding specs imply (no DDP hooks, SURVEY.md row D4).
+- Grad accumulation is ``lax.scan`` over microbatches inside the step
+  (no python-side loop, no re-dispatch per microbatch).
+- Loss is token-weighted (padding/prompt masking), accumulated exactly:
+  grads of the nll *sum* are averaged by total token weight at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import (
+    Params, forward, init_params, param_specs)
+from gke_ray_train_tpu.parallel.mesh import BATCH_AXES
+from gke_ray_train_tpu.parallel.sharding import tree_shardings
+from gke_ray_train_tpu.train.lora import LoraConfig, init_lora, lora_specs
+
+Batch = Dict[str, jnp.ndarray]
+
+
+class TrainState(NamedTuple):
+    params: Params
+    lora: Optional[Params]       # None unless LoRA mode
+    opt_state: Any
+    step: jnp.ndarray            # int32 scalar
+
+
+def token_nll(logits: jnp.ndarray, targets: jnp.ndarray,
+              weights: jnp.ndarray):
+    """Sum of weighted token NLL + sum of weights (exact-mean bookkeeping).
+
+    fp32 log-softmax regardless of compute dtype — same reduction the
+    reference gets from CrossEntropyLoss over flattened logits
+    (pytorch_llm_ray.py:233,275)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    w = weights.astype(jnp.float32)
+    return jnp.sum(nll * w), jnp.sum(w)
+
+
+def opt_state_specs(optimizer: optax.GradientTransformation,
+                    trainable_shapes: Any, trainable_specs: Any) -> Any:
+    """PartitionSpec tree for an optax state: any subtree whose structure
+    equals the trainable pytree (mu, nu, trace, ...) inherits the
+    trainable's specs; every other leaf (counts, scalars) replicates.
+    This is what makes optimizer state ZeRO-sharded by construction
+    (SURVEY.md row D5)."""
+    target_def = jax.tree.structure(trainable_shapes)
+
+    def rec(node):
+        if jax.tree.structure(node) == target_def and \
+                jax.tree.leaves(node):
+            return trainable_specs
+        if hasattr(node, "_fields"):  # NamedTuple optax states
+            return type(node)(*[rec(getattr(node, f)) for f in node._fields])
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(c) for c in node)
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        return P()
+
+    return rec(jax.eval_shape(optimizer.init, trainable_shapes))
+
+
+def make_train_state(cfg: ModelConfig, optimizer: optax.GradientTransformation,
+                     key: jax.Array, *, mesh: Optional[Mesh] = None,
+                     lora_cfg: Optional[LoraConfig] = None) -> TrainState:
+    """Initialize params (sharded at creation when a mesh is given — an 8B
+    fp32 init must never materialize on one host) and optimizer state.
+
+    Optimizer state shardings are *propagated* from param shardings by
+    jitting optimizer.init — mu/nu inherit the fsdp sharding, scalars
+    replicate. This is the ZeRO analogue (SURVEY.md row D5)."""
+    if mesh is not None:
+        p_shard = tree_shardings(mesh, param_specs(cfg))
+        params = jax.jit(lambda k: init_params(cfg, k),
+                         out_shardings=p_shard)(key)
+    else:
+        params = init_params(cfg, key)
+
+    lora = None
+    if lora_cfg is not None:
+        lkey = jax.random.fold_in(key, 1)
+        if mesh is not None:
+            l_shard = tree_shardings(mesh, lora_specs(cfg, lora_cfg))
+            lora = jax.jit(lambda k: init_lora(cfg, lora_cfg, k),
+                           out_shardings=l_shard)(lkey)
+        else:
+            lora = init_lora(cfg, lora_cfg, lkey)
+
+    trainable = lora if lora is not None else params
+    step = jnp.zeros((), jnp.int32)
+    if mesh is not None:
+        # Explicit out_shardings for every optimizer-state leaf: jit
+        # propagation alone leaves constants (adam count) and
+        # replicated-param moments on a single device, which breaks the
+        # jitted step after a checkpoint restore commits them there.
+        t_specs = (lora_specs(cfg, lora_cfg) if lora is not None
+                   else param_specs(cfg))
+        os_specs = opt_state_specs(optimizer, trainable, t_specs)
+        opt_state = jax.jit(optimizer.init,
+                            out_shardings=tree_shardings(mesh, os_specs))(
+            trainable)
+        step = jax.device_put(step, NamedSharding(mesh, P()))
+    else:
+        opt_state = jax.jit(optimizer.init)(trainable)
+    return TrainState(params=params, lora=lora, opt_state=opt_state,
+                      step=step)
+
+
+def make_train_step(cfg: ModelConfig,
+                    optimizer: optax.GradientTransformation,
+                    *,
+                    mesh: Optional[Mesh] = None,
+                    lora_cfg: Optional[LoraConfig] = None,
+                    grad_accum: int = 1,
+                    schedule: Optional[Callable] = None,
+                    donate: bool = True) -> Callable[[TrainState, Batch],
+                                                     tuple]:
+    """Build the jitted ``(state, batch) -> (state, metrics)`` function.
+
+    batch: dict with "inputs"/"targets" [B, S] int32, "weights" [B, S]
+    float, optional "segment_ids"/"positions" [B, S]. B must be divisible
+    by grad_accum; microbatches are scanned in sequence.
+    """
+    lora_mode = lora_cfg is not None
+
+    def micro_loss(trainable: Params, frozen: Params, micro: Batch):
+        if lora_mode:
+            logits = forward(frozen, micro["inputs"], cfg,
+                             positions=micro.get("positions"),
+                             segment_ids=micro.get("segment_ids"),
+                             mesh=mesh, lora=trainable,
+                             lora_scale=lora_cfg.scale)
+        else:
+            logits = forward(trainable, micro["inputs"], cfg,
+                             positions=micro.get("positions"),
+                             segment_ids=micro.get("segment_ids"),
+                             mesh=mesh)
+        nll, w = token_nll(logits, micro["targets"], micro["weights"])
+        return nll, w
+
+    def train_step(state: TrainState, batch: Batch):
+        trainable = state.lora if lora_mode else state.params
+        frozen = state.params
+
+        def reshape(x):
+            return x.reshape((grad_accum, x.shape[0] // grad_accum)
+                             + x.shape[1:])
+        micros = jax.tree.map(reshape, batch)
+
+        grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
+
+        def accum(carry, micro):
+            g_acc, nll_acc, w_acc = carry
+            (nll, w), g = grad_fn(trainable, frozen, micro)
+            return (jax.tree.map(jnp.add, g_acc, g),
+                    nll_acc + nll, w_acc + w), None
+
+        zeros = jax.tree.map(jnp.zeros_like, trainable)
+        (g_sum, nll_sum, w_sum), _ = jax.lax.scan(
+            accum, (zeros, jnp.zeros((), jnp.float32),
+                    jnp.zeros((), jnp.float32)), micros)
+
+        inv_w = jnp.where(w_sum > 0, 1.0 / w_sum, 0.0)
+        grads = jax.tree.map(lambda g: (g * inv_w).astype(g.dtype), g_sum)
+        loss = nll_sum * inv_w
+
+        updates, new_opt = optimizer.update(grads, state.opt_state, trainable)
+        new_trainable = optax.apply_updates(trainable, updates)
+
+        new_state = TrainState(
+            params=state.params if lora_mode else new_trainable,
+            lora=new_trainable if lora_mode else None,
+            opt_state=new_opt,
+            step=state.step + 1,
+        )
+        metrics = {
+            "loss": loss,
+            "grad_norm": optax.global_norm(grads),
+            "tokens": w_sum,
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.step)
+        return new_state, metrics
+
+    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+
+
+def make_eval_step(cfg: ModelConfig, *, mesh: Optional[Mesh] = None,
+                   lora_cfg: Optional[LoraConfig] = None):
+    """(state, batch) -> summed (nll, weight) — callers aggregate across
+    batches/hosts then divide (exact eval loss, SURVEY.md §5.5)."""
+    lora_mode = lora_cfg is not None
+
+    def eval_step(state: TrainState, batch: Batch):
+        logits = forward(state.params, batch["inputs"], cfg,
+                         positions=batch.get("positions"),
+                         segment_ids=batch.get("segment_ids"),
+                         mesh=mesh,
+                         lora=state.lora if lora_mode else None,
+                         lora_scale=lora_cfg.scale if lora_mode else 1.0)
+        return token_nll(logits, batch["targets"], batch["weights"])
+
+    return jax.jit(eval_step)
+
+
+def batch_shardings(mesh: Mesh, batch_keys=("inputs", "targets", "weights"),
+                    *, context_sharded: bool = False) -> Dict[str, Any]:
+    seq = "context" if context_sharded else None
+    return {k: NamedSharding(mesh, P(BATCH_AXES, seq)) for k in batch_keys}

@@ -1,0 +1,285 @@
+"""LLM fine-tune entry point — TPU-native flagship job.
+
+Capability parity with the reference fine-tune
+(/root/reference/ray-jobs/fine_tune_llama_ray.py): submitted via
+``ray job submit -- python ray-jobs/fine_tune_llama_ray.py``, reads
+``ray-jobs/fine_tune_config.json`` (same UPPER_CASE keys + mesh keys,
+SURVEY.md §5.6), runs a per-worker train fn on every TPU host, saves
+merged/full weights in HF layout to shared storage, optionally runs the
+base-vs-tuned inference comparison (§3.4).
+
+What replaces what (SURVEY.md §2b):
+- TorchTrainer/ScalingConfig        → rayint.JaxTrainer / ScalingConfig
+- Accelerate + NCCL process group    → jax.distributed + GSPMD mesh
+- BitsAndBytes NF4 QLoRA             → LoRA adapter pytree (bf16 compute);
+  BNB_* config keys are accepted and ignored (no CUDA quant kernels)
+- TRL SFTTrainer                     → jitted train step + host loop
+- HF Trainer checkpoints             → orbax manager w/ retention + resume
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s %(name)s: %(message)s")
+logger = logging.getLogger("fine_tune")
+
+
+def train_loop_per_worker(config: dict):
+    """Runs on every TPU host (same shape as the reference's worker fn,
+    fine_tune_llama_ray.py:198)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gke_ray_train_tpu.ckpt import (
+        CheckpointManager, load_hf_checkpoint, save_hf_checkpoint)
+    from gke_ray_train_tpu.data import (
+        ByteTokenizer, downsample, load_hf_tokenizer, pad_sft_rows,
+        batch_packed, pack_examples, sft_epoch_batches, synthetic_sql_rows,
+        tokenize_sft_example, format_gretel_sql_example)
+    from gke_ray_train_tpu.models import (
+        init_params, param_specs, preset_for_model_id, tiny)
+    from gke_ray_train_tpu.parallel.mesh import (
+        MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.parallel.sharding import tree_shardings
+    from gke_ray_train_tpu.rayint import get_context
+    from gke_ray_train_tpu.train import (
+        LoraConfig, ThroughputMeter, make_optimizer, make_train_state,
+        make_train_step, make_eval_step, merge_lora, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.step import TrainState
+
+    ctx = get_context()
+    distributed_init()
+    mesh = build_mesh(MeshConfig.from_dict(config))
+    n_hosts = max(jax.process_count(), 1)
+    host = jax.process_index()
+    smoke = bool(config.get("SMOKE_TEST", False))
+    logger.info("worker %d/%d; %d devices; mesh %s", host, n_hosts,
+                len(jax.devices()), dict(mesh.shape))
+
+    # ---- tokenizer + model config ------------------------------------
+    model_id = config["MODEL_ID"]
+    hf_token = os.environ.get("HF_TOKEN")
+    try:
+        tokenizer = load_hf_tokenizer(model_id, hf_token)
+    except Exception as e:
+        logger.warning("HF tokenizer unavailable (%s); using ByteTokenizer",
+                       type(e).__name__)
+        tokenizer = ByteTokenizer()
+
+    max_seq = int(config.get("MAX_SEQ_LENGTH", 1024))
+    if smoke:
+        cfg = tiny(vocab_size=max(getattr(tokenizer, "vocab_size", 260), 260),
+                   max_seq_len=max_seq, dtype=config.get("TRAIN_DTYPE",
+                                                         "float32"))
+    else:
+        cfg = preset_for_model_id(
+            model_id,
+            dtype=config.get("TRAIN_DTYPE", "bfloat16"),
+            attn_impl=config.get("ATTN_IMPL", "xla"))
+
+    # ---- weights ------------------------------------------------------
+    ckpt_dir = config.get("PRETRAINED_CHECKPOINT_DIR")
+    if ckpt_dir and os.path.exists(str(ckpt_dir)):
+        params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
+        logger.info("loaded pretrained weights from %s", ckpt_dir)
+    else:
+        if not smoke:
+            logger.warning(
+                "no PRETRAINED_CHECKPOINT_DIR; initializing random weights "
+                "(fine-tuning semantics require a pretrained checkpoint)")
+        p_shard = tree_shardings(mesh, param_specs(cfg))
+        params = jax.jit(lambda k: init_params(cfg, k),
+                         out_shardings=p_shard)(jax.random.key(0))
+
+    # ---- dataset ------------------------------------------------------
+    n_train = int(config.get("NUM_TRAIN_SAMPLES", 1000))
+    n_eval = int(config.get("NUM_EVAL_SAMPLES", 200))
+    try:
+        from datasets import load_dataset
+        ds_train = list(load_dataset(config["DATASET_NAME"], split="train"))
+        ds_test = list(load_dataset(config["DATASET_NAME"], split="test"))
+    except Exception as e:
+        logger.warning("dataset hub unavailable (%s); synthetic SQL rows",
+                       type(e).__name__)
+        ds_train = synthetic_sql_rows(max(n_train, 64), seed=0)
+        ds_test = synthetic_sql_rows(max(n_eval, 16), seed=1)
+    # downsample-with-seed parity (reference :288-289)
+    ds_train = downsample(ds_train, n_train)
+    ds_test = downsample(ds_test, n_eval)
+
+    def tokenize_rows(rows):
+        return [tokenize_sft_example(
+            tokenizer, format_gretel_sql_example(r), max_len=max_seq + 1)
+            for r in rows]
+
+    train_exs = tokenize_rows(ds_train)
+    eval_exs = tokenize_rows(ds_test)
+    n_dead = sum(1 for ex in train_exs if ex["loss_weights"].sum() == 0)
+    if n_dead:
+        logger.warning(
+            "%d/%d train examples have ZERO trainable tokens — the prompt "
+            "fills MAX_SEQ_LENGTH=%d and truncation drops the completion; "
+            "raise MAX_SEQ_LENGTH or shorten prompts", n_dead,
+            len(train_exs), max_seq)
+    if n_dead == len(train_exs):
+        raise ValueError("every train example truncated to zero trainable "
+                         "tokens; training would silently learn nothing")
+
+    per_device_batch = int(config.get("PER_DEVICE_TRAIN_BATCH_SIZE", 2))
+    grad_accum = int(config.get("GRADIENT_ACCUMULATION_STEPS", 1))
+    data_par = mesh.shape["data"] * mesh.shape["fsdp"]
+    global_batch = per_device_batch * data_par * grad_accum
+    host_batch = global_batch // n_hosts
+
+    packing = bool(config.get("PACKING", False))
+    if packing:
+        packed = list(pack_examples(train_exs, max_seq))
+        train_rows = {k: np.stack([r[k] for r in packed])
+                      for k in packed[0]}
+    else:
+        train_rows = pad_sft_rows(train_exs, max_seq)
+    eval_rows = pad_sft_rows(eval_exs, max_seq)
+
+    steps_per_epoch = max(len(train_rows["inputs"]) // global_batch, 1)
+    epochs = int(config.get("NUM_TRAIN_EPOCHS", 1))
+    total_steps = steps_per_epoch * epochs
+
+    # ---- optimizer / adapters ----------------------------------------
+    use_lora = bool(config.get("USE_QLORA", False))
+    lora_cfg = LoraConfig.from_dict(config) if use_lora else None
+    schedule = warmup_cosine_schedule(
+        float(config.get("LEARNING_RATE", 2e-4)), total_steps,
+        warmup_frac=float(config.get("WARMUP_RATIO", 0.03)))
+    opt = make_optimizer(
+        schedule,
+        weight_decay=float(config.get("WEIGHT_DECAY", 0.001)),
+        clip_norm=float(config.get("MAX_GRAD_NORM", 0.3)))
+    state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
+                             lora_cfg=lora_cfg)
+    state = TrainState(params=params, lora=state.lora,
+                       opt_state=state.opt_state, step=state.step)
+
+    step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
+                              grad_accum=grad_accum, schedule=schedule)
+    eval_fn_step = make_eval_step(cfg, mesh=mesh, lora_cfg=lora_cfg)
+
+    out_base = config.get("OUTPUT_DIR_BASE", "/tmp/grt_sft")
+    sft_dir = os.path.join(out_base, config.get("SFT_SUBDIR_NAME", "sft"))
+    mgr = CheckpointManager(
+        sft_dir, max_to_keep=1,
+        save_interval_steps=int(config.get("SAVE_STEPS_SFT", 50)))
+
+    def epoch_batches(epoch):
+        yield from sft_epoch_batches(
+            train_rows, host_batch * n_hosts, num_hosts=n_hosts,
+            host_id=host, epoch=epoch)
+
+    def eval_fn(st):
+        nll = w = 0.0
+        rows = eval_rows
+        eb = max(host_batch, 1)
+        for s in range(max(len(rows["inputs"]) // eb, 1)):
+            b = {k: v[s * eb:(s + 1) * eb] for k, v in rows.items()}
+            if len(b["inputs"]) == 0:
+                break
+            n, ww = eval_fn_step(st, b)
+            nll += float(n); w += float(ww)
+        return {"eval_loss": nll / max(w, 1.0)}
+
+    meter = ThroughputMeter(cfg, seq_len=max_seq,
+                            n_devices=len(jax.devices()))
+    state, metrics = run_training(
+        state, step_fn, epoch_batches,
+        epochs=epochs,
+        log_every=int(config.get("LOGGING_STEPS", 10)),
+        meter=meter, ckpt_manager=mgr,
+        report_fn=lambda m: ctx.report(m),
+        eval_fn=eval_fn,
+        eval_every=int(config.get("EVAL_STEPS_SFT", 50)),
+        is_host0=ctx.is_host0())
+
+    # ---- save final artifacts (HF layout, §5.4) ----------------------
+    if use_lora:
+        merged = merge_lora(state.params, state.lora, lora_cfg)
+        final_dir = os.path.join(
+            out_base, config.get("MERGED_MODEL_SUBDIR_NAME", "merged"))
+    else:
+        merged = state.params
+        final_dir = os.path.join(
+            out_base, config.get("FULL_FT_MODEL_SUBDIR_NAME", "full"))
+    if ctx.is_host0() and n_hosts == 1:
+        save_hf_checkpoint(merged, cfg, final_dir)
+        logger.info("saved final model to %s", final_dir)
+    elif n_hosts > 1:
+        # multi-host export path: orbax save (collective), convert offline
+        export_mgr = CheckpointManager(final_dir + "_orbax", max_to_keep=1,
+                                       score_attribute=None)
+        export_mgr.save(int(jax.device_get(state.step)), merged, force=True)
+        export_mgr.wait()
+
+    # ---- optional inference comparison (§3.4) ------------------------
+    if bool(config.get("INFERENCE", False)) and ctx.is_host0():
+        from gke_ray_train_tpu.inference import run_inference_comparison
+        # NOTE: the pre-training `params` handle was donated into the train
+        # step (buffer aliasing), so it must not be used here. In LoRA mode
+        # the base weights sit unchanged in state.params; in full-FT mode
+        # reload them (the reference reloads from the hub, :69-76).
+        if use_lora:
+            base_params = state.params
+        elif ckpt_dir and os.path.exists(str(ckpt_dir)):
+            base_params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
+        else:
+            logger.warning("full-FT smoke without a pretrained checkpoint: "
+                           "comparing tuned model against itself")
+            base_params = merged
+        run_inference_comparison(
+            base_params, merged, cfg, tokenizer, ds_test,
+            num_samples=int(config.get("NUM_EVAL_SAMPLES_INFERENCE", 2)),
+            max_new_tokens=int(
+                config.get("MAX_NEW_GENERATION_TOKENS_INFERENCE", 300)),
+            output_path=os.path.join(out_base, "inference_comparison.json"),
+            row_filter=(lambda r: r.get("sql_complexity")
+                        == "window functions"))
+    return metrics
+
+
+if __name__ == "__main__":
+    from gke_ray_train_tpu.rayint import JaxTrainer, RunConfig, ScalingConfig
+    from gke_ray_train_tpu.rayint.trainer import FailureConfig
+
+    cfg_path = os.environ.get(
+        "FINE_TUNE_CONFIG",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "fine_tune_config.json"))
+    try:
+        with open(cfg_path) as f:
+            config = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        logger.error("failed to load %s: %s", cfg_path, e)
+        sys.exit(1)
+
+    scaling = ScalingConfig.from_env()
+    trainer = JaxTrainer(
+        train_loop_per_worker,
+        train_loop_config=config,
+        scaling_config=scaling,
+        run_config=RunConfig(
+            name="llama-sft-tpu",
+            storage_path=config.get("OUTPUT_DIR_BASE"),
+            failure_config=FailureConfig(
+                max_failures=int(os.environ.get("MAX_FAILURES", "0")))),
+    )
+    result = trainer.fit()
+    if result.error:
+        logger.error("training failed: %s", result.error)
+        sys.exit(1)
+    logger.info("final metrics: %s", result.metrics)

@@ -1,0 +1,42 @@
+"""Dataset-prep job (SURVEY.md §3.3).
+
+Capability parity with
+/root/reference/ray-jobs/prepare_wikitext2_ray_job.py: a 1-CPU Ray task
+downloads wikitext-2-raw-v1 and writes concatenated raw text per split to
+shared storage, idempotently; the driver submits and waits with a 30-min
+timeout. Runs locally (no Ray) with the same code path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(level=logging.INFO)
+logger = logging.getLogger("prepare_wikitext2")
+
+OUTPUT_DIR = os.environ.get("DATA_DIR", "/mnt/pvc/data")
+
+
+def prepare_task(output_dir: str) -> dict:
+    from gke_ray_train_tpu.data import prepare_wikitext2
+    return prepare_wikitext2(
+        output_dir,
+        synthetic_fallback=os.environ.get("SYNTHETIC_FALLBACK", "0") == "1")
+
+
+if __name__ == "__main__":
+    try:
+        import ray
+        ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
+        task = ray.remote(num_cpus=1)(prepare_task)
+        ref = task.remote(OUTPUT_DIR)
+        paths = ray.get(ref, timeout=1800)  # reference: 30-min timeout
+    except (ImportError, ConnectionError) as e:
+        logger.info("no Ray cluster (%s); running locally", type(e).__name__)
+        paths = prepare_task(OUTPUT_DIR)
+    for split, p in paths.items():
+        logger.info("%s: %s (%d bytes)", split, p, os.path.getsize(p))

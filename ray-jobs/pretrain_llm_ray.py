@@ -1,0 +1,172 @@
+"""From-scratch LLM pre-train entry point — the visible, hackable loop.
+
+Capability parity with the reference pre-train
+(/root/reference/ray-jobs/pytorch_llm_ray.py): char-tokenize wikitext-2,
+train a ~1.2B decoder-only transformer (2048d/24L/16H/8192ff) with
+warmup-cosine AdamW, grad clip 1.0, rank-0 logging every 20 batches,
+per-epoch checkpoints with keep-1-best-by-loss retention.
+
+TPU redesigns worth noting:
+- The reference's filesystem data barrier (rank 0 writes _DATA_PREP_DONE,
+  others poll sleep(5), pytorch_llm_ray.py:156-188) is replaced by host-0
+  prep + a real collective barrier
+  (multihost_utils.sync_global_devices) — no eventually-consistent-FUSE
+  race (SURVEY.md §5.2).
+- DDP + DistributedSampler become mesh sharding + ShardedBatches.
+- Resume-from-latest-checkpoint actually works (§5.3 gap-fix).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+logging.basicConfig(level=logging.INFO,
+                    format="%(asctime)s %(name)s: %(message)s")
+logger = logging.getLogger("pretrain")
+
+
+def train_loop_per_worker(config: dict):
+    import jax
+    import numpy as np
+
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.data import (
+        CharTokenizer, ShardedBatches, SlidingWindowDataset,
+        prepare_wikitext2)
+    from gke_ray_train_tpu.models import basic_lm
+    from gke_ray_train_tpu.parallel.mesh import (
+        MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.rayint import get_context
+    from gke_ray_train_tpu.train import (
+        ThroughputMeter, make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    ctx = get_context()
+    distributed_init()
+    mesh = build_mesh(MeshConfig.from_dict(config))
+    n_hosts = max(jax.process_count(), 1)
+    host = jax.process_index()
+
+    data_dir = config.get("data_dir", "/mnt/pvc/data")
+    tok_path = os.path.join(data_dir, "char_tokenizer.json")
+    ids_path = os.path.join(data_dir, "wikitext2_train_ids.npy")
+
+    # ---- host-0 data prep + collective barrier -----------------------
+    if host == 0 and not (os.path.exists(tok_path)
+                          and os.path.exists(ids_path)):
+        paths = prepare_wikitext2(data_dir, synthetic_fallback=True)
+        text = open(paths["train"]).read()
+        tok = CharTokenizer.fit(text)
+        tok.save(tok_path)
+        np.save(ids_path, tok.encode(text))
+        logger.info("data prep done: %d tokens, vocab %d",
+                    os.path.getsize(ids_path) // 4, tok.vocab_size)
+    if n_hosts > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("data_prep_done")
+
+    tok = CharTokenizer.load(tok_path)
+    ids = np.load(ids_path)
+    seq_len = int(config.get("dataset_seq_len", 256))
+    dataset = SlidingWindowDataset(ids, seq_len)
+
+    cfg = basic_lm(
+        vocab_size=tok.vocab_size,
+        d_model=int(config.get("d_model", 2048)),
+        n_layers=int(config.get("n_layers", 24)),
+        n_heads=int(config.get("n_heads", 16)),
+        d_ff=int(config.get("d_ff", 8192)),
+        max_seq_len=max(seq_len, int(config.get("model_max_seq_len", 1024))),
+        dtype=config.get("dtype", "bfloat16"),
+    )
+
+    global_batch = int(config.get("batch_size_per_device", 16)) \
+        * mesh.shape["data"] * mesh.shape["fsdp"]
+    # test_run parity: cap at 16k samples (pytorch_llm_ray.py:198-201);
+    # "max_samples" shrinks further for fast CI smoke
+    max_samples = (int(config["max_samples"]) if "max_samples" in config
+                   else (16_000 if config.get("test_run", True) else None))
+    batches = ShardedBatches(
+        dataset, global_batch, num_hosts=n_hosts, host_id=host,
+        max_samples=max_samples)
+
+    epochs = int(config.get("epochs", 1))
+    total_steps = batches.steps_per_epoch() * epochs
+    schedule = warmup_cosine_schedule(
+        float(config.get("lr", 3e-4)), total_steps,
+        warmup_frac=float(config.get("warmup_frac", 0.05)),
+        min_lr_frac=float(config.get("min_lr_frac", 0.01)))
+    opt = make_optimizer(schedule,
+                         weight_decay=float(config.get("weight_decay", 0.01)),
+                         clip_norm=float(config.get("grad_clip", 1.0)))
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+
+    step_fn = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
+    mgr = CheckpointManager(
+        os.path.join(config.get("storage_path",
+                                "/mnt/pvc/ray_llm_training_runs"),
+                     config.get("run_name", "basic_lm")),
+        max_to_keep=1, score_attribute="loss", score_mode="min")
+
+    meter = ThroughputMeter(cfg, seq_len=seq_len,
+                            n_devices=len(jax.devices()))
+    state, metrics = run_training(
+        state, step_fn, lambda e: batches.iter_epoch(e),
+        epochs=epochs,
+        log_every=int(config.get("log_every", 20)),
+        meter=meter, ckpt_manager=mgr,
+        report_fn=lambda m: ctx.report(m),
+        is_host0=ctx.is_host0())
+    return metrics
+
+
+if __name__ == "__main__":
+    from gke_ray_train_tpu.rayint import JaxTrainer, RunConfig, ScalingConfig
+    from gke_ray_train_tpu.rayint.trainer import FailureConfig
+
+    # hardcoded driver config, reference-style (pytorch_llm_ray.py:324-344),
+    # with env overrides for smoke runs
+    smoke = os.environ.get("SMOKE_TEST", "0") == "1"
+    train_loop_config = {
+        "d_model": 256 if smoke else 2048,
+        "n_layers": 4 if smoke else 24,
+        "n_heads": 8 if smoke else 16,
+        "d_ff": 1024 if smoke else 8192,
+        "dataset_seq_len": 128 if smoke else 256,
+        "model_max_seq_len": 1024,
+        "batch_size_per_device": 4 if smoke else 16,
+        "lr": 3e-4, "weight_decay": 0.01,
+        "warmup_frac": 0.05, "min_lr_frac": 0.01, "grad_clip": 1.0,
+        "epochs": 1,
+        "test_run": True,
+        **({"max_samples": int(os.environ.get("MAX_SAMPLES", "1600"))}
+           if smoke else {}),
+        "log_every": 20,
+        "dtype": "float32" if smoke else "bfloat16",
+        "data_dir": os.environ.get("DATA_DIR", "/mnt/pvc/data"),
+        "storage_path": os.environ.get(
+            "STORAGE_PATH", "/mnt/pvc/ray_llm_training_runs"),
+        "run_name": "basic_lm_pretrain",
+        "MESH_FSDP": int(os.environ.get("MESH_FSDP", "-1")),
+        "MESH_DATA": int(os.environ.get("MESH_DATA", "1")),
+    }
+    trainer = JaxTrainer(
+        train_loop_per_worker,
+        train_loop_config=train_loop_config,
+        scaling_config=ScalingConfig.from_env(),
+        run_config=RunConfig(
+            name="basic-lm-pretrain",
+            storage_path=train_loop_config["storage_path"],
+            failure_config=FailureConfig(
+                max_failures=int(os.environ.get("MAX_FAILURES", "0")))),
+    )
+    result = trainer.fit()
+    if result.error:
+        logger.error("training failed: %s", result.error)
+        sys.exit(1)
+    logger.info("final metrics: %s", result.metrics)

@@ -1,0 +1,151 @@
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.data import (
+    CharTokenizer, ShardedBatches, SlidingWindowDataset, batch_packed,
+    downsample, format_gretel_sql_example, pack_examples, prepare_wikitext2,
+    render_chat, tokenize_sft_example, UNK_ID)
+
+
+def test_char_tokenizer_roundtrip(tmp_path):
+    tok = CharTokenizer.fit("hello world")
+    ids = tok.encode("hello")
+    assert tok.decode(ids) == "hello"
+    assert tok.encode("z")[0] == UNK_ID  # unseen char
+    p = str(tmp_path / "tok.json")
+    tok.save(p)
+    tok2 = CharTokenizer.load(p)
+    assert tok2.decode(tok2.encode("world")) == "world"
+    assert tok2.vocab_size == tok.vocab_size
+
+
+def test_sliding_window_pairs():
+    ids = np.arange(100, dtype=np.int32)
+    ds = SlidingWindowDataset(ids, seq_len=8)
+    assert len(ds) == 92
+    b = ds.gather(np.asarray([0, 5]))
+    np.testing.assert_array_equal(b["inputs"][0], np.arange(8))
+    np.testing.assert_array_equal(b["targets"][0], np.arange(1, 9))
+    np.testing.assert_array_equal(b["inputs"][1], np.arange(5, 13))
+
+
+def test_sharded_batches_partition():
+    """Two hosts see disjoint, jointly-exhaustive samples; deterministic
+    across re-iteration; reshuffled across epochs."""
+    ds = SlidingWindowDataset(np.arange(1000, dtype=np.int32), seq_len=4)
+    def firsts(host):
+        sb = ShardedBatches(ds, global_batch=8, num_hosts=2, host_id=host)
+        return [b["inputs"][:, 0].tolist() for b in sb.iter_epoch(0)]
+    h0, h1 = firsts(0), firsts(1)
+    assert len(h0) == len(h1) == 996 // 8
+    flat0 = {x for step in h0 for x in step}
+    flat1 = {x for step in h1 for x in step}
+    assert not (flat0 & flat1)
+    assert firsts(0) == firsts(0)  # deterministic
+    sb = ShardedBatches(ds, global_batch=8, num_hosts=2, host_id=0)
+    e1 = [b["inputs"][:, 0].tolist() for b in sb.iter_epoch(1)]
+    assert e1 != h0  # epoch reshuffle
+
+
+def test_sharded_batches_max_samples():
+    ds = SlidingWindowDataset(np.arange(10000, dtype=np.int32), seq_len=4)
+    sb = ShardedBatches(ds, global_batch=16, max_samples=160)
+    assert sb.steps_per_epoch() == 10
+
+
+def test_gretel_formatter():
+    row = {"sql_context": "CREATE TABLE t(a int);", "sql_task_type": "query",
+           "sql_prompt": "count rows", "sql": "SELECT COUNT(*) FROM t;"}
+    msgs = format_gretel_sql_example(row)
+    assert "CREATE TABLE" in msgs["system"]
+    assert msgs["assistant"].startswith("SELECT")
+
+
+class FakeTok:
+    """Minimal tokenizer stand-in: one id per character."""
+    chat_template = None
+
+    def __call__(self, text, add_special_tokens=False):
+        return {"input_ids": [ord(c) % 50000 for c in text]}
+
+
+def test_sft_prompt_masking():
+    msgs = {"system": "sys", "user": "u", "assistant": "ANSWER"}
+    ex = tokenize_sft_example(FakeTok(), msgs, max_len=512)
+    assert ex["input_ids"].shape == ex["loss_weights"].shape
+    # prompt part masked, completion part not
+    assert ex["loss_weights"][0] == 0.0
+    assert ex["loss_weights"][-2] == 1.0
+    n_on = int(ex["loss_weights"].sum())
+    assert 0 < n_on <= len("ANSWER") + 2
+    ex2 = tokenize_sft_example(FakeTok(), msgs, max_len=512,
+                               train_on_prompt=True)
+    assert ex2["loss_weights"].min() == 1.0
+
+
+def test_render_chat_fallback_and_generation_prompt():
+    msgs = {"system": "s", "user": "u", "assistant": "a"}
+    full = render_chat(FakeTok(), msgs)
+    gen = render_chat(FakeTok(), msgs, add_generation_prompt=True)
+    assert full.startswith(gen[: len("<|system|>")])
+    assert "a" in full
+    assert gen.endswith("<|assistant|>\n")
+
+
+def test_downsample_seeded():
+    rows = list(range(100))
+    a = downsample(rows, 10)
+    b = downsample(rows, 10)
+    assert a == b and len(a) == 10
+    assert downsample(rows, None) == rows
+
+
+def test_packing_segments():
+    exs = [
+        {"input_ids": np.arange(10, 16), "loss_weights": np.ones(6)},   # 5
+        {"input_ids": np.arange(20, 24), "loss_weights": np.ones(4)},   # 3
+        {"input_ids": np.arange(30, 37), "loss_weights": np.ones(7)},   # 6
+    ]
+    rows = list(pack_examples(exs, seq_len=8))
+    assert len(rows) == 2
+    r0 = rows[0]
+    # first row: ex0 (5 slots, seg 1) + ex1 (3 slots, seg 2)
+    np.testing.assert_array_equal(r0["segment_ids"],
+                                  [1, 1, 1, 1, 1, 2, 2, 2])
+    np.testing.assert_array_equal(r0["inputs"][:5], np.arange(10, 15))
+    np.testing.assert_array_equal(r0["targets"][:5], np.arange(11, 16))
+    np.testing.assert_array_equal(r0["positions"][:8],
+                                  [0, 1, 2, 3, 4, 0, 1, 2])
+    # second row: ex2 with padding tail (seg 0, weight 0)
+    r1 = rows[1]
+    assert r1["segment_ids"][-1] == 0
+    assert r1["weights"][-1] == 0.0
+
+
+def test_packing_truncates_long():
+    exs = [{"input_ids": np.arange(100), "loss_weights": np.ones(100)}]
+    rows = list(pack_examples(exs, seq_len=8))
+    assert len(rows) == 1
+    assert rows[0]["segment_ids"].tolist() == [1] * 8
+
+
+def test_batch_packed_pads_final():
+    exs = [{"input_ids": np.arange(9), "loss_weights": np.ones(9)}
+           for _ in range(3)]
+    batches = list(batch_packed(pack_examples(exs, 8), 2, drop_last=False))
+    assert len(batches) == 2
+    assert batches[0]["inputs"].shape == (2, 8)
+    assert batches[1]["weights"][1].sum() == 0  # padded row
+
+
+def test_prepare_synthetic_idempotent(tmp_path):
+    out = prepare_wikitext2(str(tmp_path), synthetic_fallback=True,
+                            synthetic_chars=5000)
+    assert set(out) == {"train", "validation", "test"}
+    sizes = {k: len(open(v).read()) for k, v in out.items()}
+    assert sizes["train"] >= 4999
+    # idempotent second call keeps the files
+    import os
+    mtimes = {k: os.path.getmtime(v) for k, v in out.items()}
+    out2 = prepare_wikitext2(str(tmp_path), synthetic_fallback=True)
+    assert {k: os.path.getmtime(v) for k, v in out2.items()} == mtimes

@@ -1,0 +1,64 @@
+from gke_ray_train_tpu.rayint import (
+    FailureConfig, JaxTrainer, RunConfig, ScalingConfig, get_context, report)
+
+
+def test_local_fit_returns_reported_metrics():
+    def worker(config):
+        ctx = get_context()
+        assert ctx.get_world_size() == 1
+        assert ctx.get_world_rank() == 0
+        report({"loss": 1.5, "epoch": config["epochs"] - 1})
+
+    t = JaxTrainer(worker, train_loop_config={"epochs": 3}, use_ray=False)
+    res = t.fit()
+    assert res.error is None
+    assert res.metrics["loss"] == 1.5
+    assert res.metrics["epoch"] == 2
+
+
+def test_local_fit_return_value_wins():
+    t = JaxTrainer(lambda c: {"x": 1}, use_ray=False)
+    assert t.fit().metrics == {"x": 1}
+
+
+def test_failure_config_retries():
+    calls = {"n": 0}
+
+    def flaky(config):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return {"ok": calls["n"]}
+
+    t = JaxTrainer(flaky, use_ray=False,
+                   run_config=RunConfig(
+                       failure_config=FailureConfig(max_failures=3)))
+    res = t.fit()
+    assert res.metrics == {"ok": 3}
+    assert calls["n"] == 3
+
+
+def test_failures_exhausted_reports_error():
+    def broken(config):
+        raise RuntimeError("permanent")
+
+    t = JaxTrainer(broken, use_ray=False,
+                   run_config=RunConfig(
+                       failure_config=FailureConfig(max_failures=1)))
+    res = t.fit()
+    assert res.error == "permanent"
+    assert res.metrics == {}
+
+
+def test_scaling_config_from_env(monkeypatch):
+    monkeypatch.setenv("NUM_HOSTS", "4")
+    monkeypatch.setenv("CHIPS_PER_HOST", "8")
+    sc = ScalingConfig.from_env()
+    assert sc.num_workers == 4
+    assert sc.resources_per_worker == {"TPU": 8}
+    # legacy reference names as fallback (NUM_NODES/NUM_GPUS_PER_NODE)
+    monkeypatch.delenv("NUM_HOSTS")
+    monkeypatch.delenv("CHIPS_PER_HOST")
+    monkeypatch.setenv("NUM_NODES", "2")
+    sc = ScalingConfig.from_env()
+    assert sc.num_workers == 2
