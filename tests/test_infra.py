@@ -1,0 +1,91 @@
+"""Infra-dir parity tests (SURVEY.md C14-C17).
+
+The reference ships per-hardware dirs (a3-mega/, a3-ultra/) each with a
+setup runbook + RayCluster CR; ours are tpu-v5e/ and tpu-v5p/. These
+tests substitute the envsubst variables and check the TPU contracts the
+trainer relies on (one worker per host, google.com/tpu resources, the
+/mnt/pvc FUSE mount on every pod).
+"""
+
+import os
+import re
+import subprocess
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV = {
+    "KSA_NAME": "tpu-ray",
+    "GSBUCKET": "test-bucket",
+    "NUM_HOSTS": "4",
+    "CHIPS_PER_HOST": "4",
+    "TPU_ACCELERATOR": "tpu-v5-lite-podslice",
+    "TPU_TOPOLOGY": "4x4",
+}
+
+
+def _render(path):
+    text = open(path).read()
+    for k, v in ENV.items():
+        text = text.replace("${%s}" % k, v)
+    assert "${" not in text, f"unsubstituted var in {path}"
+    return yaml.safe_load(text)
+
+
+@pytest.mark.parametrize("hw", ["tpu-v5e", "tpu-v5p"])
+def test_raycluster_cr_contract(hw):
+    doc = _render(os.path.join(REPO, hw, "ray-cluster-config.yaml"))
+    assert doc["kind"] == "RayCluster"
+    head = doc["spec"]["headGroupSpec"]
+    # head schedules no tasks (reference a3-mega/ray-cluster-config.yaml:10)
+    assert head["rayStartParams"]["num-cpus"] == "0"
+
+    (group,) = doc["spec"]["workerGroupSpecs"]
+    # one worker pod per TPU host, whole slice atomic
+    assert group["numOfHosts"] == 4
+    container = group["template"]["spec"]["containers"][0]
+    assert container["resources"]["limits"]["google.com/tpu"] == 4
+    sel = group["template"]["spec"]["nodeSelector"]
+    assert "cloud.google.com/gke-tpu-accelerator" in sel
+    assert "cloud.google.com/gke-tpu-topology" in sel
+    # graceful drain hook preserved
+    assert container["lifecycle"]["preStop"]["exec"]["command"][-1] == "ray stop"
+
+    # /mnt/pvc FUSE mount contract on head AND workers
+    for spec in (head["template"]["spec"], group["template"]["spec"]):
+        mounts = {m["mountPath"] for c in spec["containers"]
+                  for m in c["volumeMounts"]}
+        assert "/mnt/pvc" in mounts and "/mnt/hf_cache" in mounts
+        drivers = {v.get("csi", {}).get("driver") for v in spec["volumes"]}
+        assert "gcsfuse.csi.storage.gke.io" in drivers
+
+
+@pytest.mark.parametrize("hw", ["tpu-v5e", "tpu-v5p"])
+def test_setup_script_shape(hw):
+    path = os.path.join(REPO, hw, "gke-ray-cluster-setup.sh")
+    text = open(path).read()
+    # bash-parses cleanly
+    subprocess.run(["bash", "-n", path], check=True)
+    # runbook order parity (reference a3-mega/gke-ray-cluster-setup.sh):
+    # cluster → tpu pool → bucket → IAM → secret → apply → submit
+    order = [
+        r"gcloud container clusters create",
+        r"node-pools create",
+        r"buckets create",
+        r"add-iam-policy-binding",
+        r"hf-secret",
+        r"envsubst < " + hw,
+        r"ray job submit",
+    ]
+    pos = 0
+    for pat in order:
+        m = re.search(pat, text[pos:])
+        assert m, f"{pat} missing/out of order in {path}"
+        pos += m.end()
+    # TPU env analogues of NUM_NODES/NUM_GPUS_PER_NODE reach the job
+    assert "NUM_HOSTS" in text and "CHIPS_PER_HOST" in text
+    # zero GPU nodes anywhere
+    assert "nvidia" not in text.lower()
