@@ -3,9 +3,10 @@
 Measures sustained training throughput (tokens/sec/chip) and MFU on the
 attached accelerator(s) for the flagship-architecture model at the
 largest size that fits comfortably, using the real jitted train step
-(loss+grad+clip+adamw, bf16 compute). Timing uses block_until_ready
-around a multi-step window (the tunneled TPU dispatches asynchronously;
-per-step host timings are meaningless).
+(loss+grad+clip+adamw, bf16 compute). Timing syncs via a forced
+device→host transfer of the final loss minus the measured tunnel
+round-trip; per-step host timings (and, with Pallas kernels on the
+tunneled TPU, block_until_ready) are unreliable.
 
 vs_baseline: ratio against the reference's *published* numbers — the
 reference publishes none (BASELINE.md), so the recorded baseline is this
@@ -73,13 +74,25 @@ def main():
 
     # warmup/compile
     state, m = step(state, batch)
-    jax.block_until_ready(m["loss"])
+    float(jax.device_get(m["loss"]))
+
+    # Timing: a forced device->host transfer of the last step's loss is
+    # the sync point — on the tunneled TPU, block_until_ready can return
+    # before the chain finishes (observed with Pallas kernels), while a
+    # value transfer cannot lie. Subtract the measured tunnel round-trip
+    # so latency isn't billed to the train step.
+    lat_probe = jax.jit(lambda x: x + 1)
+    float(jax.device_get(lat_probe(jnp.zeros(()))))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(jax.device_get(lat_probe(jnp.zeros(()))))
+    latency = (time.perf_counter() - t0) / 3
 
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step(state, batch)
-    jax.block_until_ready(m["loss"])
-    dt = time.perf_counter() - t0
+    last_loss = float(jax.device_get(m["loss"]))
+    dt = max(time.perf_counter() - t0 - latency, 1e-9)
 
     tokens = B * S * steps
     tps_chip = tokens / dt / n_dev
@@ -107,7 +120,7 @@ def main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tps_chip / baseline, 3) if baseline else 1.0,
         "mfu": round(mfu, 4),
-        "loss": round(float(jax.device_get(m["loss"])), 4),
+        "loss": round(last_loss, 4),
     }
     print(json.dumps(result))
 

@@ -53,7 +53,8 @@ class ModelConfig:
     dtype: str = "bfloat16"                 # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = True                      # checkpoint each block
-    attn_impl: str = "xla"                  # "xla" | "flash" | "ring"
+    attn_impl: str = "auto"                 # "auto" | "xla" | "flash" | "ring"
+    # "auto" resolves at trace time: flash (Pallas) on TPU, xla oracle off-TPU
 
     def __post_init__(self):
         # keep the config hashable (jit static arg): dicts → sorted tuples
@@ -77,12 +78,19 @@ class ModelConfig:
             raise ValueError("block_pattern contains 'sliding' but "
                              "sliding_window is None — that would silently "
                              "run full global attention")
-        if self.attn_impl not in ("xla", "flash", "ring"):
+        if self.attn_impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
 
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.d_model // self.n_heads
+
+    @property
+    def resolved_attn_impl(self) -> str:
+        if self.attn_impl != "auto":
+            return self.attn_impl
+        import jax
+        return "flash" if jax.default_backend() == "tpu" else "xla"
 
     @property
     def n_repeats(self) -> int:

@@ -166,8 +166,8 @@ def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0):
     return _proj(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype)
 
 
-def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh,
-          lora_p=None, lora_scale=1.0):
+def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
+          window, segment_ids, mesh, lora_p=None, lora_scale=1.0):
     B, S, D = x.shape
     hd = cfg.resolved_head_dim
     H, K = cfg.n_heads, cfg.n_kv_heads
@@ -185,16 +185,20 @@ def _attn(x, lp, cfg: ModelConfig, dtype, rope, positions, mask, mesh,
     if rope is not None:
         q = apply_rope(q, positions, rope)
         k = apply_rope(k, positions, rope)
-    if cfg.attn_impl == "xla":
+    if impl == "xla":
         out = dot_product_attention(
             q, k, v, mask, scale=cfg.attn_scale,
             logit_softcap=cfg.attn_softcap)
     else:
-        # flash (pallas) and ring (context-parallel) kernels plug in here
+        # flash (pallas) / ring (context-parallel) kernels take the mask
+        # *inputs*, never a materialized [S, S] mask
         from gke_ray_train_tpu.ops.dispatch import attention_dispatch
-        out = attention_dispatch(cfg.attn_impl, q, k, v, mask,
-                                 scale=cfg.attn_scale,
-                                 logit_softcap=cfg.attn_softcap, mesh=mesh)
+        out = attention_dispatch(
+            impl, q, k, v,
+            q_positions=positions, kv_positions=positions,
+            q_segment_ids=segment_ids, kv_segment_ids=segment_ids,
+            causal=True, sliding_window=window, scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_softcap, mesh=mesh)
     out = out.reshape(B, S, H * hd)
     return _proj(out, lp["wo"], lr("wo"), lora_scale, dtype)
 
@@ -231,12 +235,21 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             llama3_scaling=cfg.rope_scaling))
     x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
 
-    # masks are shared by every layer of the same kind — build once
-    masks = {}
-    for kind in set(cfg.block_pattern):
-        masks[kind] = make_attention_mask(
-            positions, positions, segment_ids, segment_ids, causal=True,
-            sliding_window=cfg.sliding_window if kind == "sliding" else None)
+    impl = cfg.resolved_attn_impl
+    if impl == "flash" and S % 128 != 0:
+        # flash needs a 128-multiple sequence to tile; odd eval/infer
+        # lengths fall back to the dense-mask oracle instead of crashing
+        impl = "xla"
+
+    # dense masks are shared by every layer of the same kind — build once.
+    # Kernel impls (flash/ring) build masks blockwise in-kernel instead.
+    masks = {kind: None for kind in set(cfg.block_pattern)}
+    if impl == "xla":
+        for kind in masks:
+            masks[kind] = make_attention_mask(
+                positions, positions, segment_ids, segment_ids, causal=True,
+                sliding_window=(cfg.sliding_window if kind == "sliding"
+                                else None))
 
     def repeat_body(x, xs_slice):
         layer_slice = xs_slice[0]
@@ -245,8 +258,10 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lp = layer_slice[p]
             lo = lora_slice[p] if lora_slice is not None else None
             h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
-            h = _attn(h, lp, cfg, dtype, rope, positions, masks[kind], mesh,
-                      lora_p=lo, lora_scale=lora_scale)
+            h = _attn(h, lp, cfg, impl, dtype, rope, positions,
+                      masks[kind],
+                      cfg.sliding_window if kind == "sliding" else None,
+                      segment_ids, mesh, lora_p=lo, lora_scale=lora_scale)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["attn_post_norm"], eps=eps,
                              scale_plus_one=sp1)

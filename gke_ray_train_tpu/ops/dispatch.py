@@ -1,26 +1,101 @@
 """Attention implementation dispatch (cfg.attn_impl).
 
-"xla" is handled inline in the transformer; this module routes the
-accelerated paths so the model code never imports kernels directly.
+"xla" is handled inline in the transformer (dense mask oracle); this
+module routes the accelerated paths — "flash" (Pallas kernel) and "ring"
+(context-parallel flash) — so the model code never imports kernels
+directly. Both take mask *inputs* (positions, segment ids, causality,
+window) rather than a materialized [S, T] mask: never building that mask
+in HBM is the point of the kernels.
+
+Sharding: a ``pallas_call`` is a custom call GSPMD cannot partition, so
+under a mesh the flash kernel is wrapped in ``shard_map`` — each device
+runs the kernel on its local (batch x head) shard. That is correct only
+while the sequence axis is unsharded; a context-sharded mesh must use
+"ring" (each device holds a sequence shard and K/V blocks rotate around
+the context axis).
 """
 
 from __future__ import annotations
 
+from typing import Optional
 
-def attention_dispatch(impl: str, q, k, v, mask, *, scale=None,
-                       logit_softcap=None, mesh=None):
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from gke_ray_train_tpu.parallel.mesh import (
+    AXIS_CONTEXT, AXIS_MODEL, BATCH_AXES)
+
+
+def _flash_sharded(q, k, v, q_positions, kv_positions, q_segment_ids,
+                   kv_segment_ids, *, mesh, causal, sliding_window, scale,
+                   logit_softcap, interpret):
+    from gke_ray_train_tpu.ops.flash_attention import flash_attention
+
+    def local(q, k, v, qp, kp, qs, ks):
+        return flash_attention(
+            q, k, v, q_positions=qp, kv_positions=kp, q_segment_ids=qs,
+            kv_segment_ids=ks, causal=causal,
+            sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap, interpret=interpret)
+
+    if mesh is None:
+        return local(q, k, v, q_positions, kv_positions, q_segment_ids,
+                     kv_segment_ids)
+
+    if mesh.shape[AXIS_CONTEXT] > 1:
+        raise ValueError(
+            "attn_impl='flash' with a context-sharded mesh would silently "
+            "drop cross-shard attention; use attn_impl='ring'")
+
+    qkv_spec = P(BATCH_AXES, None, AXIS_MODEL, None)
+    vec_spec = P(BATCH_AXES, None)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec,
+                  vec_spec, vec_spec, vec_spec, vec_spec),
+        out_specs=qkv_spec, check_rep=False,
+    )(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids)
+
+
+def attention_dispatch(impl: str, q, k, v, *,
+                       q_positions=None, kv_positions=None,
+                       q_segment_ids=None, kv_segment_ids=None,
+                       causal: bool = True,
+                       sliding_window: Optional[int] = None,
+                       scale=None, logit_softcap=None, mesh=None,
+                       interpret: Optional[bool] = None) -> jnp.ndarray:
+    B, S = q.shape[:2]
+    T = k.shape[1]
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
+                                       (B, S))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32),
+                                        (B, T))
+    if q_segment_ids is None:
+        q_segment_ids = jnp.ones((B, S), jnp.int32)
+    if kv_segment_ids is None:
+        kv_segment_ids = jnp.ones((B, T), jnp.int32)
+
     if impl == "flash":
+        return _flash_sharded(
+            q, k, v, q_positions, kv_positions, q_segment_ids,
+            kv_segment_ids, mesh=mesh, causal=causal,
+            sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap, interpret=interpret)
+    if impl == "ring":
         try:
-            from gke_ray_train_tpu.ops.flash_attention import flash_attention
+            from gke_ray_train_tpu.ops.ring_attention import ring_attention
         except ImportError as e:
             raise NotImplementedError(
-                "attn_impl='flash' requested but the Pallas kernel is not "
-                "available in this build") from e
-        return flash_attention(q, k, v, mask, scale=scale,
-                               logit_softcap=logit_softcap)
-    if impl == "ring":
-        raise NotImplementedError(
-            "attn_impl='ring' goes through forward(..., segment_ids/"
-            "positions) with a context-sharded mesh; ring attention is "
-            "wired at the ops/ring_attention.py level")
+                "attn_impl='ring' requires ops/ring_attention.py, not yet "
+                "in this build") from e
+        return ring_attention(
+            q, k, v, mesh=mesh, q_positions=q_positions,
+            kv_positions=kv_positions, q_segment_ids=q_segment_ids,
+            kv_segment_ids=kv_segment_ids, causal=causal,
+            sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap, interpret=interpret)
     raise ValueError(f"unknown attn_impl {impl!r}")

@@ -84,7 +84,7 @@ def train_loop_per_worker(config: dict):
         cfg = preset_for_model_id(
             model_id,
             dtype=config.get("TRAIN_DTYPE", "bfloat16"),
-            attn_impl=config.get("ATTN_IMPL", "xla"))
+            attn_impl=config.get("ATTN_IMPL", "auto"))
 
     # ---- weights ------------------------------------------------------
     ckpt_dir = config.get("PRETRAINED_CHECKPOINT_DIR")
