@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from gke_ray_train_tpu.parallel.mesh import (
@@ -55,7 +55,7 @@ def _flash_sharded(q, k, v, q_positions, kv_positions, q_segment_ids,
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec,
                   vec_spec, vec_spec, vec_spec, vec_spec),
-        out_specs=qkv_spec, check_rep=False,
+        out_specs=qkv_spec, check_vma=False,
     )(q, k, v, q_positions, kv_positions, q_segment_ids, kv_segment_ids)
 
 

@@ -56,6 +56,33 @@ def _softcap_fwd(s, cap):
     return jnp.tanh(s / cap) * cap if cap is not None else s
 
 
+FULL_BLOCK_LIMIT = 2048  # max seq to load as one VMEM block
+
+
+def pick_block(requested: int, n: int) -> int:
+    """A block size that tiles n exactly and satisfies Mosaic tiling.
+
+    The Pallas grid covers n // block blocks — a non-divisor block would
+    silently leave the tail rows unwritten, so this guard is mandatory
+    for every caller of the kernels (flash_attention and ring_attention).
+    Preference: largest 128-multiple divisor of n that is <= requested;
+    otherwise the full length (a block equal to the array dim is always
+    tiling-legal), capped by VMEM sanity."""
+    best = None
+    for b in range(128, min(requested, n) + 1, 128):
+        if n % b == 0:
+            best = b
+    if best is None:
+        if n <= FULL_BLOCK_LIMIT:
+            best = n
+        else:
+            raise ValueError(
+                f"sequence length {n} has no 128-multiple block divisor "
+                f"<= {requested} and is too long for a single block; pad "
+                f"to a multiple of 128 and mask via segment_ids")
+    return best
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -266,7 +293,7 @@ def _dkv_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
 
 def _bwd(res, g, *, scale, causal, window, softcap, block_q, block_kv,
-         interpret):
+         interpret, dvec=None):
     q, k, v, out, lse, q_pos, kv_pos, q_seg, kv_seg = res
     B, H, S, dh = q.shape
     K, T = k.shape[1], k.shape[2]
@@ -275,9 +302,11 @@ def _bwd(res, g, *, scale, causal, window, softcap, block_q, block_kv,
     n_kv = T // block_kv
 
     # D_i = sum_d do_id * o_id, one scalar per query row (fp32) — tiny,
-    # XLA fuses it; not worth a kernel.
-    dvec = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                   axis=-1)[:, :, None, :]
+    # XLA fuses it; not worth a kernel. Ring attention precomputes it
+    # once outside its per-shard loop and passes it in.
+    if dvec is None:
+        dvec = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                       axis=-1)[:, :, None, :]
 
     vec_specs = [
         pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, 0, i)),
@@ -397,19 +426,8 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         # run the same kernel under the Pallas interpreter
         interpret = jax.default_backend() != "tpu"
     scale = dh ** -0.5 if scale is None else scale
-
-    def _pick_block(requested: int, n: int) -> int:
-        b = min(requested, n)
-        while b > 128 and n % b:
-            b //= 2
-        if n % b:
-            raise ValueError(
-                f"sequence length {n} has no block divisor <= {requested}; "
-                f"pad to a multiple of 128 and mask via segment_ids")
-        return b
-
-    block_q = _pick_block(block_q, S)
-    block_kv = _pick_block(block_kv, T)
+    block_q = pick_block(block_q, S)
+    block_kv = pick_block(block_kv, T)
 
     if q_positions is None:
         q_positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32),
