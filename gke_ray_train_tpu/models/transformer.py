@@ -138,9 +138,14 @@ def _proj(x, w, lora_p, lora_scale, dtype):
 
     The LoRA path is two small matmuls (never a materialized delta-W) —
     the TPU-native replacement for peft's adapter modules (reference:
-    ray-jobs/fine_tune_llama_ray.py:245-252, SURVEY.md row D6).
+    ray-jobs/fine_tune_llama_ray.py:245-252, SURVEY.md row D6). ``w``
+    may be a quantized QTensor (QLoRA base weights, SURVEY.md row D5) —
+    dequantized here, in-jit, so XLA fuses it into the matmul prologue.
     """
-    y = jnp.einsum("bsd,dh->bsh", x, w.astype(dtype))
+    # local import: ops.quant -> train.lora -> models.transformer is a
+    # module-level chain, so this reverse edge must stay deferred
+    from gke_ray_train_tpu.ops.quant import maybe_dequantize
+    y = jnp.einsum("bsd,dh->bsh", x, maybe_dequantize(w, dtype))
     if lora_p is not None:
         xa = jnp.einsum("bsd,dr->bsr", x, lora_p["a"].astype(dtype))
         y = y + jnp.einsum("bsr,rh->bsh", xa, lora_p["b"].astype(dtype)) \

@@ -42,17 +42,27 @@ def run_training(state: TrainState,
                  eval_fn: Optional[Callable] = None,
                  eval_every: Optional[int] = None,
                  place_batch: Optional[Callable] = None,
+                 ckpt_view: Optional[tuple] = None,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
     epoch_batches(epoch) → iterable of host-local numpy batch dicts.
     place_batch(batch) → device arrays (sharded form-up); default asis.
     report_fn(metrics_dict) → trainer-context report (Ray or local).
+    ckpt_view: optional (save_view, load_view) pair mapping the state to
+    the subset the checkpoint persists — LoRA mode saves only adapters +
+    optimizer state (the frozen/quantized base is rebuilt from the
+    pretrained weights on resume, and quantized uint4 codes are not
+    serializable anyway).
     """
+    save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
+    load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
     if ckpt_manager is not None:
-        state, resumed = ckpt_manager.restore_if_available(state)
-        if resumed is not None and is_host0:
-            logger.info("resumed at step %d", resumed)
+        view, resumed = ckpt_manager.restore_if_available(save_view(state))
+        if resumed is not None:
+            state = load_view(state, view)
+            if is_host0:
+                logger.info("resumed at step %d", resumed)
 
     last_metrics = {}
     global_step = int(jax.device_get(state.step))
@@ -95,7 +105,7 @@ def run_training(state: TrainState,
             epoch_metrics.update(meter.snapshot())
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
-            ckpt_manager.save(global_step, state, metrics=m_host)
+            ckpt_manager.save(global_step, save_view(state), metrics=m_host)
         if report_fn is not None:
             report_fn(epoch_metrics)
 

@@ -105,11 +105,26 @@ def merge_lora(params: Params, lora: Params, lora_cfg: LoraConfig) -> Params:
     """W + (alpha/r) A@B for every adapted matrix — the equivalent of
     peft's merge_and_unload (reference fine_tune_llama_ray.py:349-353),
     but a pure function on pytrees (jit/shard friendly)."""
+    # local import: ops.quant imports ALL_TARGETS from this module at
+    # module scope, so the reverse dependency must stay deferred
+    from gke_ray_train_tpu.ops.quant import (
+        dequantize, is_qtensor, maybe_dequantize)
+
     merged = jax.tree.map(lambda x: x, params)  # shallow-ish copy
     for p_blk, l_blk in zip(merged["blocks"], lora["blocks"]):
         for t, ab in l_blk.items():
             delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32),
                                ab["b"].astype(jnp.float32)) * lora_cfg.scale
-            p_blk[t] = (p_blk[t].astype(jnp.float32) + delta).astype(
-                p_blk[t].dtype)
+            # QLoRA bases dequantize on merge — peft's merge_and_unload
+            # does the same before folding the adapters in
+            base = maybe_dequantize(p_blk[t], jnp.float32)
+            out_dtype = (jnp.float32 if is_qtensor(p_blk[t])
+                         else p_blk[t].dtype)
+            p_blk[t] = (base + delta).astype(out_dtype)
+        # quantized weights WITHOUT adapters (e.g. q/v-only LoRA) must
+        # still come back to full precision — the HF export consumes
+        # plain arrays only
+        for t, w in p_blk.items():
+            if is_qtensor(w):
+                p_blk[t] = dequantize(w, jnp.float32)
     return merged

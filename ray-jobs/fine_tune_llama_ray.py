@@ -11,8 +11,8 @@ base-vs-tuned inference comparison (§3.4).
 What replaces what (SURVEY.md §2b):
 - TorchTrainer/ScalingConfig        → rayint.JaxTrainer / ScalingConfig
 - Accelerate + NCCL process group    → jax.distributed + GSPMD mesh
-- BitsAndBytes NF4 QLoRA             → LoRA adapter pytree (bf16 compute);
-  BNB_* config keys are accepted and ignored (no CUDA quant kernels)
+- BitsAndBytes NF4 QLoRA             → LoRA adapter pytree over an
+  NF4/int8-quantized frozen base (ops/quant.py; QUANT_KIND config key)
 - TRL SFTTrainer                     → jitted train step + host loop
 - HF Trainer checkpoints             → orbax manager w/ retention + resume
 """
@@ -165,6 +165,16 @@ def train_loop_per_worker(config: dict):
         clip_norm=float(config.get("MAX_GRAD_NORM", 0.3)))
     state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
                              lora_cfg=lora_cfg)
+    # QLoRA = LoRA adapters over a *quantized* frozen base (the
+    # reference's BitsAndBytesConfig 4-bit NF4 load,
+    # fine_tune_llama_ray.py:216-227) — here a pytree transform
+    # (ops/quant.py), dequantized inside the jitted forward.
+    quant_kind = str(config.get("QUANT_KIND", "nf4" if use_lora else
+                                "none")).lower()
+    if use_lora and quant_kind != "none":
+        from gke_ray_train_tpu.ops.quant import quantize_params
+        params = quantize_params(params, kind=quant_kind)
+        logger.info("quantized frozen base weights to %s", quant_kind)
     state = TrainState(params=params, lora=state.lora,
                        opt_state=state.opt_state, step=state.step)
 
@@ -197,6 +207,16 @@ def train_loop_per_worker(config: dict):
 
     meter = ThroughputMeter(cfg, seq_len=max_seq,
                             n_devices=len(jax.devices()))
+    # LoRA checkpoints persist only adapters + optimizer state: the
+    # frozen (possibly NF4-quantized) base is rebuilt from the pretrained
+    # weights on resume — smaller checkpoints, and sub-byte code arrays
+    # never hit the serializer.
+    ckpt_view = None
+    if use_lora:
+        ckpt_view = (
+            lambda st: st._replace(params={}),
+            lambda st, v: v._replace(params=st.params),
+        )
     state, metrics = run_training(
         state, step_fn, epoch_batches,
         epochs=epochs,
@@ -205,6 +225,7 @@ def train_loop_per_worker(config: dict):
         report_fn=lambda m: ctx.report(m),
         eval_fn=eval_fn,
         eval_every=int(config.get("EVAL_STEPS_SFT", 50)),
+        ckpt_view=ckpt_view,
         is_host0=ctx.is_host0())
 
     # ---- save final artifacts (HF layout, §5.4) ----------------------

@@ -1,0 +1,180 @@
+"""Weight quantization (ops/quant.py) — NF4/int8 QLoRA parity (D5)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.ops.quant import (
+    QTensor, dequantize, is_qtensor, quant_specs, quantize_params,
+    quantize_tensor)
+
+
+@pytest.mark.parametrize("kind,tol", [("nf4", 0.15), ("int8", 0.012)])
+def test_round_trip_error_bounds(kind, tol):
+    w = jax.random.normal(jax.random.key(0), (2, 128, 64)) * 0.02
+    qt = quantize_tensor(w, kind)
+    back = dequantize(qt, jnp.float32)
+    assert back.shape == w.shape
+    # relative error vs per-group absmax
+    err = np.abs(np.asarray(back - w))
+    scale = np.abs(np.asarray(w)).max()
+    assert err.max() / scale < tol, f"{kind}: {err.max() / scale}"
+
+
+def test_nf4_storage_is_4bit_codes():
+    w = jax.random.normal(jax.random.key(1), (64, 32))
+    qt = quantize_tensor(w, "nf4")
+    assert qt.codes.dtype in (jnp.uint4, jnp.int8)
+    codes = np.asarray(qt.codes.astype(jnp.int32))
+    assert codes.min() >= 0 and codes.max() <= 15
+
+
+def test_exact_for_codebook_values():
+    """Weights that sit exactly on scaled codebook points reconstruct
+    exactly (scale = absmax of the group)."""
+    from gke_ray_train_tpu.ops.quant import NF4_CODEBOOK
+    scale = 0.5
+    w = jnp.asarray(NF4_CODEBOOK * scale)[None, :, None]  # [1, 16, 1]
+    qt = quantize_tensor(jnp.broadcast_to(w, (1, 16, 4)), "nf4", group=16)
+    back = dequantize(qt, jnp.float32)
+    np.testing.assert_allclose(back[0, :, 0], NF4_CODEBOOK * scale,
+                               atol=1e-6)
+
+
+def test_odd_group_fallback():
+    w = jax.random.normal(jax.random.key(2), (3, 96, 8))  # 96 % 64 != 0
+    qt = quantize_tensor(w, "nf4")
+    assert qt.group == 48  # largest divisor of 96 <= 64
+    assert dequantize(qt).shape == w.shape
+
+
+def test_quantize_params_targets_only_projections():
+    from gke_ray_train_tpu.models import init_params, tiny
+
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128)
+    params = init_params(cfg, jax.random.key(0))
+    qp = quantize_params(params, "nf4")
+    blk = qp["blocks"][0]
+    for t in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        assert is_qtensor(blk[t]), t
+    assert not is_qtensor(blk["attn_norm"])
+    assert not is_qtensor(qp["embed"])
+
+
+def test_forward_with_quantized_base_close_to_fp():
+    from gke_ray_train_tpu.models import forward, init_params, tiny
+
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128, dtype="float32",
+               param_dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 64)
+    ref = forward(params, tokens, cfg)
+    out = forward(quantize_params(params, "int8"), tokens, cfg)
+    # int8 per-group: logits drift but ordering should survive
+    agree = (np.argmax(np.asarray(out), -1)
+             == np.argmax(np.asarray(ref), -1)).mean()
+    assert agree > 0.95, agree
+
+
+def test_qlora_train_step_loss_decreases():
+    """Full QLoRA slice: NF4 frozen base + trainable LoRA on a sharded
+    mesh; only adapters update, loss decreases."""
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.sharding import tree_shardings
+    from gke_ray_train_tpu.models.transformer import param_specs
+    from gke_ray_train_tpu.train import (
+        LoraConfig, make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.step import TrainState, batch_shardings
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=1))
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128, dtype="float32",
+               param_dtype="float32")
+    lora_cfg = LoraConfig(r=4, alpha=8.0)
+    sch = warmup_cosine_schedule(5e-3, 20)
+    opt = make_optimizer(sch)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh,
+                             lora_cfg=lora_cfg)
+    qparams = quantize_params(state.params, "nf4")
+    state = TrainState(params=qparams, lora=state.lora,
+                       opt_state=state.opt_state, step=state.step)
+    step = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
+                           schedule=sch)
+    B, S = 4, 32
+    batch = {
+        "inputs": jax.random.randint(jax.random.key(1), (B, S), 0, 64),
+        "targets": jax.random.randint(jax.random.key(2), (B, S), 0, 64),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+    batch = jax.device_put(batch, batch_shardings(mesh))
+    losses = []
+    for _ in range(6):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    # frozen base unchanged (still the same quantized codes)
+    assert is_qtensor(state.params["blocks"][0]["wq"])
+
+
+def test_merge_lora_with_quantized_base():
+    from gke_ray_train_tpu.models import init_params, tiny
+    from gke_ray_train_tpu.train import LoraConfig
+    from gke_ray_train_tpu.train.lora import init_lora, merge_lora
+
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128)
+    params = init_params(cfg, jax.random.key(0))
+    lora_cfg = LoraConfig(r=4, alpha=8.0)
+    lora = init_lora(cfg, lora_cfg, jax.random.key(1))
+    # make b nonzero so the merge moves weights
+    lora = jax.tree.map(lambda x: x + 0.01, lora)
+
+    merged_fp = merge_lora(params, lora, lora_cfg)
+    merged_q = merge_lora(quantize_params(params, "int8"), lora, lora_cfg)
+    wq_fp = np.asarray(merged_fp["blocks"][0]["wq"], dtype=np.float32)
+    wq_q = np.asarray(merged_q["blocks"][0]["wq"], dtype=np.float32)
+    assert not is_qtensor(merged_q["blocks"][0]["wq"])
+    np.testing.assert_allclose(wq_q, wq_fp, atol=2e-3)
+
+
+def test_quant_specs_and_sharding():
+    from gke_ray_train_tpu.models import init_params, tiny
+    from gke_ray_train_tpu.models.transformer import param_specs
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.sharding import tree_shardings
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=4, model=2, context=1))
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128)
+    params = quantize_params(init_params(cfg, jax.random.key(0)), "nf4")
+    specs = quant_specs(param_specs(cfg), params, mesh)
+    sharded = jax.device_put(params, tree_shardings(mesh, specs))
+    wq = sharded["blocks"][0]["wq"]
+    assert is_qtensor(wq)
+    # codes sharded like the fp weight would be
+    assert wq.codes.sharding.spec == param_specs(cfg)["blocks"][0]["wq"]
+
+
+def test_merge_lora_partial_targets_dequantizes_rest():
+    """q/v-only LoRA over a fully quantized base: merge must return plain
+    arrays for ALL weights (the HF export cannot take QTensors)."""
+    from gke_ray_train_tpu.models import init_params, tiny
+    from gke_ray_train_tpu.train import LoraConfig
+    from gke_ray_train_tpu.train.lora import init_lora, merge_lora
+
+    cfg = tiny(vocab_size=64, d_model=64, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=128)
+    params = quantize_params(init_params(cfg, jax.random.key(0)), "nf4")
+    lora_cfg = LoraConfig(r=4, alpha=8.0, targets=("wq", "wv"))
+    lora = init_lora(cfg, lora_cfg, jax.random.key(1))
+    merged = merge_lora(params, lora, lora_cfg)
+    for blk in merged["blocks"]:
+        for t in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            assert not is_qtensor(blk[t]), t
