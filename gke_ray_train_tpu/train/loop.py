@@ -43,6 +43,7 @@ def run_training(state: TrainState,
                  eval_every: Optional[int] = None,
                  place_batch: Optional[Callable] = None,
                  ckpt_view: Optional[tuple] = None,
+                 profiler=None,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
@@ -66,7 +67,8 @@ def run_training(state: TrainState,
 
     last_metrics = {}
     global_step = int(jax.device_get(state.step))
-    for epoch in range(epochs):
+    try:
+      for epoch in range(epochs):
         if meter is not None:
             meter.reset()
         for batch in epoch_batches(epoch):
@@ -74,6 +76,8 @@ def run_training(state: TrainState,
                 batch = place_batch(batch)
             state, m = train_step(state, batch)
             global_step += 1
+            if profiler is not None:
+                profiler.step(global_step)
             if meter is not None:
                 # tokens metric is device-resident; fetching it each step
                 # would sync — use the (static) batch token count instead
@@ -108,6 +112,11 @@ def run_training(state: TrainState,
             ckpt_manager.save(global_step, save_view(state), metrics=m_host)
         if report_fn is not None:
             report_fn(epoch_metrics)
+    finally:
+        # a failing step must still flush an in-flight trace — the
+        # profile matters most in exactly that case
+        if profiler is not None:
+            profiler.close()
 
     if ckpt_manager is not None:
         ckpt_manager.wait()

@@ -54,9 +54,12 @@ def train_loop_per_worker(config: dict):
         LoraConfig, ThroughputMeter, make_optimizer, make_train_state,
         make_train_step, make_eval_step, merge_lora, warmup_cosine_schedule)
     from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.profiling import (
+        apply_debug_flags, profiler_from_config)
     from gke_ray_train_tpu.train.step import TrainState
 
     ctx = get_context()
+    apply_debug_flags(config)
     distributed_init()
     mesh = build_mesh(MeshConfig.from_dict(config))
     n_hosts = max(jax.process_count(), 1)
@@ -226,6 +229,8 @@ def train_loop_per_worker(config: dict):
         eval_fn=eval_fn,
         eval_every=int(config.get("EVAL_STEPS_SFT", 50)),
         ckpt_view=ckpt_view,
+        profiler=profiler_from_config(
+            config, os.path.join(out_base, "profile")),
         is_host0=ctx.is_host0())
 
     # ---- save final artifacts (HF layout, §5.4) ----------------------

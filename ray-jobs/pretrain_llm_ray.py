@@ -115,12 +115,16 @@ def train_loop_per_worker(config: dict):
 
     meter = ThroughputMeter(cfg, seq_len=seq_len,
                             n_devices=len(jax.devices()))
+    from gke_ray_train_tpu.train.profiling import profiler_from_config
     state, metrics = run_training(
         state, step_fn, lambda e: batches.iter_epoch(e),
         epochs=epochs,
         log_every=int(config.get("log_every", 20)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),
+        profiler=profiler_from_config(
+            config, os.path.join(config.get("storage_path", "/tmp"),
+                                 "profile")),
         is_host0=ctx.is_host0())
     return metrics
 

@@ -1,0 +1,67 @@
+"""Profiler hooks (train/profiling.py, SURVEY.md §5.1)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gke_ray_train_tpu.train.profiling import (
+    TraceProfiler, apply_debug_flags, profiler_from_config)
+
+
+def test_trace_window_writes_xprof_files(tmp_path):
+    logdir = str(tmp_path / "profile")
+    prof = TraceProfiler(logdir, start_step=2, num_steps=2)
+    f = jax.jit(lambda x: x * 2 + 1)
+    x = jnp.ones((64, 64))
+    for step in range(1, 7):
+        x = f(x)
+        prof.step(step)
+    prof.close()
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any("xplane" in p or p.endswith(".pb") or "trace" in p
+               for p in files), files
+
+
+def test_profiler_from_config_off_by_default(tmp_path):
+    assert profiler_from_config({}, str(tmp_path)) is None
+    p = profiler_from_config({"PROFILE": True, "PROFILE_START_STEP": 3,
+                              "PROFILE_NUM_STEPS": 2}, str(tmp_path))
+    assert p.start_step == 3 and p.stop_step == 5
+    p2 = profiler_from_config({"PROFILE": str(tmp_path / "custom")},
+                              str(tmp_path))
+    assert p2.logdir.endswith("custom")
+
+
+def test_run_training_with_profiler(tmp_path):
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    sch = warmup_cosine_schedule(1e-3, 10)
+    opt = make_optimizer(sch)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, schedule=sch)
+
+    def batches(epoch):
+        for i in range(4):
+            yield {
+                "inputs": jax.random.randint(jax.random.key(i), (2, 16),
+                                             0, 64),
+                "targets": jax.random.randint(jax.random.key(i + 9),
+                                              (2, 16), 0, 64),
+                "weights": jnp.ones((2, 16), jnp.float32),
+            }
+
+    logdir = str(tmp_path / "prof")
+    prof = TraceProfiler(logdir, start_step=1, num_steps=2)
+    state, metrics = run_training(state, step, batches, epochs=1,
+                                  log_every=2, profiler=prof)
+    assert prof._done
+    assert os.path.isdir(logdir)
