@@ -39,12 +39,27 @@ class TraceProfiler:
     def __init__(self, logdir: str, start_step: int = 10,
                  num_steps: int = 5):
         self.logdir = logdir
-        self.start_offset = start_step
+        self.start_step = start_step
         self.num_steps = num_steps
         self._first = None           # first global step seen this run
         self._stop_at = None
         self._active = False
         self._done = False
+
+    @property
+    def start_offset(self) -> int:
+        """Back-compat alias for start_step (read/write)."""
+        return self.start_step
+
+    @start_offset.setter
+    def start_offset(self, v: int) -> None:
+        self.start_step = v
+
+    @property
+    def stop_step(self) -> int:
+        """Exclusive end of the trace window relative to this run's
+        first step: traced steps are [start_step, stop_step)."""
+        return self.start_step + self.num_steps
 
     def step(self, global_step: int) -> None:
         """Call once per train step, AFTER the step ran (post-increment
@@ -58,7 +73,7 @@ class TraceProfiler:
         # start_trace after `start_offset` steps have completed, so the
         # first *traced* step is first + start_offset
         if not self._active and \
-                global_step >= self._first + self.start_offset - 1:
+                global_step >= self._first + self.start_step - 1:
             try:
                 jax.profiler.start_trace(self.logdir)
                 self._active = True
