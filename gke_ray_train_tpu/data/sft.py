@@ -94,13 +94,28 @@ def pad_sft_rows(examples: List[Dict[str, np.ndarray]], seq_len: int,
 
 def sft_epoch_batches(rows: Dict[str, np.ndarray], global_batch: int, *,
                       num_hosts: int = 1, host_id: int = 0, seed: int = 42,
-                      epoch: int = 0, shuffle: bool = True):
+                      epoch: int = 0, shuffle: bool = True,
+                      group_by_length: bool = False):
     """Shuffle + shard + batch pre-padded SFT rows ([N, S] arrays).
-    Mirrors ShardedBatches' host partitioning for the SFT path."""
+    Mirrors ShardedBatches' host partitioning for the SFT path.
+
+    ``group_by_length`` (reference GROUP_BY_LENGTH,
+    fine_tune_config.json:29; HF LengthGroupedSampler semantics): batches
+    are formed from similar-length examples (less padding waste), with
+    the *batch order* reshuffled per epoch."""
     n = len(rows["inputs"])
-    order = np.arange(n)
-    if shuffle:
-        np.random.default_rng(seed + epoch).shuffle(order)
+    if group_by_length:
+        lengths = np.count_nonzero(rows["inputs"], axis=1)
+        by_len = np.argsort(lengths, kind="stable")[::-1]
+        nb = max(n // global_batch, 0)
+        batches = by_len[:nb * global_batch].reshape(nb, global_batch)
+        if shuffle:
+            np.random.default_rng(seed + epoch).shuffle(batches, axis=0)
+        order = batches.reshape(-1)
+    else:
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed + epoch).shuffle(order)
     host_batch = global_batch // num_hosts
     steps = n // global_batch
     for s in range(steps):

@@ -133,7 +133,7 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def _proj(x, w, lora_p, lora_scale, dtype):
+def _proj(x, w, lora_p, lora_scale, dtype, drop_rng=None, drop_rate=0.0):
     """x @ w, plus the low-rank LoRA bypass when adapters are present.
 
     The LoRA path is two small matmuls (never a materialized delta-W) —
@@ -141,13 +141,22 @@ def _proj(x, w, lora_p, lora_scale, dtype):
     ray-jobs/fine_tune_llama_ray.py:245-252, SURVEY.md row D6). ``w``
     may be a quantized QTensor (QLoRA base weights, SURVEY.md row D5) —
     dequantized here, in-jit, so XLA fuses it into the matmul prologue.
+
+    ``drop_rng``/``drop_rate``: LoRA dropout (reference LORA_DROPOUT,
+    fine_tune_config.json:32) — peft semantics: dropout on the *adapter
+    branch input only*, the frozen-base path never drops.
     """
     # local import: ops.quant -> train.lora -> models.transformer is a
     # module-level chain, so this reverse edge must stay deferred
     from gke_ray_train_tpu.ops.quant import maybe_dequantize
     y = jnp.einsum("bsd,dh->bsh", x, maybe_dequantize(w, dtype))
     if lora_p is not None:
-        xa = jnp.einsum("bsd,dr->bsr", x, lora_p["a"].astype(dtype))
+        xl = x
+        if drop_rng is not None and drop_rate > 0.0:
+            keep = 1.0 - drop_rate
+            mask = jax.random.bernoulli(drop_rng, keep, x.shape)
+            xl = jnp.where(mask, x / keep, jnp.zeros((), dtype)).astype(dtype)
+        xa = jnp.einsum("bsd,dr->bsr", xl, lora_p["a"].astype(dtype))
         y = y + jnp.einsum("bsr,rh->bsh", xa, lora_p["b"].astype(dtype)) \
             * jnp.asarray(lora_scale, dtype)
     return y
@@ -157,31 +166,43 @@ def _lora_entry(lora_p, name):
     return None if lora_p is None or name not in lora_p else lora_p[name]
 
 
-def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0):
+def _drop_key(rng, tag: int):
+    return None if rng is None else jax.random.fold_in(rng, tag)
+
+
+def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0,
+         drop_rng=None, drop_rate=0.0):
     def lr(name):
         return _lora_entry(lora_p, name)
-    gate = _proj(x, lp["w_gate"], lr("w_gate"), lora_scale, dtype)
-    up = _proj(x, lp["w_up"], lr("w_up"), lora_scale, dtype)
+    gate = _proj(x, lp["w_gate"], lr("w_gate"), lora_scale, dtype,
+                 _drop_key(drop_rng, 4), drop_rate)
+    up = _proj(x, lp["w_up"], lr("w_up"), lora_scale, dtype,
+               _drop_key(drop_rng, 5), drop_rate)
     if cfg.activation == "silu":
         act = jax.nn.silu(gate)
     elif cfg.activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
         raise ValueError(f"unknown activation {cfg.activation}")
-    return _proj(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype)
+    return _proj(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype,
+                 _drop_key(drop_rng, 6), drop_rate)
 
 
 def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
-          window, segment_ids, mesh, lora_p=None, lora_scale=1.0):
+          window, segment_ids, mesh, lora_p=None, lora_scale=1.0,
+          drop_rng=None, drop_rate=0.0):
     B, S, D = x.shape
     hd = cfg.resolved_head_dim
     H, K = cfg.n_heads, cfg.n_kv_heads
 
     def lr(name):
         return _lora_entry(lora_p, name)
-    q = _proj(x, lp["wq"], lr("wq"), lora_scale, dtype)
-    k = _proj(x, lp["wk"], lr("wk"), lora_scale, dtype)
-    v = _proj(x, lp["wv"], lr("wv"), lora_scale, dtype)
+    q = _proj(x, lp["wq"], lr("wq"), lora_scale, dtype,
+              _drop_key(drop_rng, 0), drop_rate)
+    k = _proj(x, lp["wk"], lr("wk"), lora_scale, dtype,
+              _drop_key(drop_rng, 1), drop_rate)
+    v = _proj(x, lp["wv"], lr("wv"), lora_scale, dtype,
+              _drop_key(drop_rng, 2), drop_rate)
     q = q.reshape(B, S, H, hd)
     k = k.reshape(B, S, K, hd)
     v = v.reshape(B, S, K, hd)
@@ -205,7 +226,8 @@ def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
             causal=True, sliding_window=window, scale=cfg.attn_scale,
             logit_softcap=cfg.attn_softcap, mesh=mesh)
     out = out.reshape(B, S, H * hd)
-    return _proj(out, lp["wo"], lr("wo"), lora_scale, dtype)
+    return _proj(out, lp["wo"], lr("wo"), lora_scale, dtype,
+                 _drop_key(drop_rng, 3), drop_rate)
 
 
 def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
@@ -213,12 +235,18 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             segment_ids: Optional[jnp.ndarray] = None,
             mesh: Optional[Mesh] = None,
             lora: Optional[Params] = None,
-            lora_scale: float = 1.0) -> jnp.ndarray:
+            lora_scale: float = 1.0,
+            lora_dropout: float = 0.0,
+            lora_rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``lora``: optional adapter pytree from train/lora.py (same block
     structure as params, leaves {"a","b"}); base weights stay frozen —
     the caller decides what is trainable via the grad argnum/mask.
+
+    ``lora_dropout``/``lora_rng``: adapter-input dropout (reference
+    LORA_DROPOUT). Active only when BOTH are given — inference and merge
+    paths pass neither, so they stay deterministic.
     """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -256,24 +284,35 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
                 sliding_window=(cfg.sliding_window if kind == "sliding"
                                 else None))
 
+    # per-repeat dropout keys ride the scan alongside the block params so
+    # every layer draws an independent mask
+    drop_keys = None
+    if lora is not None and lora_rng is not None and lora_dropout > 0.0:
+        drop_keys = jax.random.split(lora_rng, cfg.n_repeats)
+
     def repeat_body(x, xs_slice):
         layer_slice = xs_slice[0]
-        lora_slice = xs_slice[1] if len(xs_slice) > 1 else None
+        lora_slice = xs_slice[1] if lora is not None else None
+        rep_rng = xs_slice[-1] if drop_keys is not None else None
         for p, kind in enumerate(cfg.block_pattern):
             lp = layer_slice[p]
             lo = lora_slice[p] if lora_slice is not None else None
+            drng = (jax.random.fold_in(rep_rng, p)
+                    if rep_rng is not None else None)
             h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
             h = _attn(h, lp, cfg, impl, dtype, rope, positions,
                       masks[kind],
                       cfg.sliding_window if kind == "sliding" else None,
-                      segment_ids, mesh, lora_p=lo, lora_scale=lora_scale)
+                      segment_ids, mesh, lora_p=lo, lora_scale=lora_scale,
+                      drop_rng=_drop_key(drng, 0), drop_rate=lora_dropout)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["attn_post_norm"], eps=eps,
                              scale_plus_one=sp1)
             x = x + h
             x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
             h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale)
+            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale,
+                     drop_rng=_drop_key(drng, 1), drop_rate=lora_dropout)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
                              scale_plus_one=sp1)
@@ -284,9 +323,12 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     body = repeat_body
     if cfg.remat:
         body = jax.checkpoint(repeat_body, prevent_cse=False)
-    xs = (params["blocks"],) if lora is None else (
-        params["blocks"], lora["blocks"])
-    x, _ = jax.lax.scan(body, x, xs)
+    xs = [params["blocks"]]
+    if lora is not None:
+        xs.append(lora["blocks"])
+    if drop_keys is not None:
+        xs.append(drop_keys)
+    x, _ = jax.lax.scan(body, x, tuple(xs))
 
     x = rms_norm(x, params["final_norm"], eps=eps, scale_plus_one=sp1)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])

@@ -41,6 +41,8 @@ def run_training(state: TrainState,
                  report_fn: Optional[Callable] = None,
                  eval_fn: Optional[Callable] = None,
                  eval_every: Optional[int] = None,
+                 eval_at_epoch_end: bool = False,
+                 ckpt_every: Optional[int] = None,
                  place_batch: Optional[Callable] = None,
                  ckpt_view: Optional[tuple] = None,
                  profiler=None,
@@ -71,6 +73,7 @@ def run_training(state: TrainState,
       for epoch in range(epochs):
         if meter is not None:
             meter.reset()
+        m = None
         for batch in epoch_batches(epoch):
             if place_batch is not None:
                 batch = place_batch(batch)
@@ -101,12 +104,26 @@ def run_training(state: TrainState,
                 last_metrics.update(eval_metrics)
                 if is_host0:
                     logger.info("eval @ %d: %s", global_step, eval_metrics)
+            # SAVE_STRATEGY="steps": mid-epoch checkpoints (HF save_steps
+            # semantics, reference fine_tune_config.json:22-23)
+            if ckpt_manager is not None and ckpt_every and \
+                    global_step % ckpt_every == 0:
+                m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
+                ckpt_manager.save(global_step, save_view(state),
+                                  metrics=m_host)
 
         # end of epoch: checkpoint + report (collective; all hosts enter)
+        if m is None:
+            raise ValueError(
+                f"epoch {epoch} produced 0 batches — the dataset is "
+                "smaller than one global batch (shrink GLOBAL_BATCH / "
+                "PER_DEVICE_TRAIN_BATCH_SIZE or grow the dataset)")
         m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
         epoch_metrics = {"epoch": epoch, "step": global_step, **m_host}
         if meter is not None:
             epoch_metrics.update(meter.snapshot())
+        if eval_fn is not None and eval_at_epoch_end:
+            epoch_metrics.update(eval_fn(state))
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
             ckpt_manager.save(global_step, save_view(state), metrics=m_host)

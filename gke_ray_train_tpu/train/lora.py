@@ -30,8 +30,9 @@ class LoraConfig:
     r: int = 64
     alpha: int = 16
     targets: Tuple[str, ...] = ALL_TARGETS
-    # dropout on the adapter input (reference LORA_DROPOUT). Applied by the
-    # train step when an rng is provided; inference/merge ignore it.
+    # dropout on the adapter-branch input (reference LORA_DROPOUT,
+    # fine_tune_config.json:32). The train step applies it with a
+    # per-(step, microbatch) rng; inference/merge ignore it.
     dropout: float = 0.0
 
     @property

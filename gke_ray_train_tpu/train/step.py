@@ -141,14 +141,18 @@ def make_train_step(cfg: ModelConfig,
     by grad_accum; microbatches are scanned in sequence.
     """
     lora_mode = lora_cfg is not None
+    lora_dropout = lora_cfg.dropout if lora_mode else 0.0
 
-    def micro_loss(trainable: Params, frozen: Params, micro: Batch):
+    def micro_loss(trainable: Params, frozen: Params, micro: Batch,
+                   drop_rng=None):
         if lora_mode:
             logits = forward(frozen, micro["inputs"], cfg,
                              positions=micro.get("positions"),
                              segment_ids=micro.get("segment_ids"),
                              mesh=mesh, lora=trainable,
-                             lora_scale=lora_cfg.scale)
+                             lora_scale=lora_cfg.scale,
+                             lora_dropout=lora_dropout,
+                             lora_rng=drop_rng)
         else:
             logits = forward(trainable, micro["inputs"], cfg,
                              positions=micro.get("positions"),
@@ -168,16 +172,28 @@ def make_train_step(cfg: ModelConfig,
 
         grad_fn = jax.value_and_grad(micro_loss, has_aux=True)
 
-        def accum(carry, micro):
+        # LoRA dropout rng: deterministic per (step, microbatch) — derived
+        # from the step counter so resume reproduces the same masks and
+        # the step fn keeps its (state, batch) signature
+        drop_rngs = None
+        if lora_mode and lora_dropout > 0.0:
+            drop_rngs = jax.random.split(
+                jax.random.fold_in(jax.random.key(0), state.step),
+                grad_accum)
+
+        def accum(carry, xs):
+            micro = xs[0]
+            drop_rng = xs[1] if drop_rngs is not None else None
             g_acc, nll_acc, w_acc = carry
-            (nll, w), g = grad_fn(trainable, frozen, micro)
+            (nll, w), g = grad_fn(trainable, frozen, micro, drop_rng)
             return (jax.tree.map(jnp.add, g_acc, g),
                     nll_acc + nll, w_acc + w), None
 
         zeros = jax.tree.map(jnp.zeros_like, trainable)
+        scan_xs = (micros,) if drop_rngs is None else (micros, drop_rngs)
         (g_sum, nll_sum, w_sum), _ = jax.lax.scan(
             accum, (zeros, jnp.zeros((), jnp.float32),
-                    jnp.zeros((), jnp.float32)), micros)
+                    jnp.zeros((), jnp.float32)), scan_xs)
 
         inv_w = jnp.where(w_sum > 0, 1.0 / w_sum, 0.0)
         grads = jax.tree.map(lambda g: (g * inv_w).astype(g.dtype), g_sum)

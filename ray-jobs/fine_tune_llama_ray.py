@@ -51,14 +51,21 @@ def train_loop_per_worker(config: dict):
     from gke_ray_train_tpu.parallel.sharding import tree_shardings
     from gke_ray_train_tpu.rayint import get_context
     from gke_ray_train_tpu.train import (
-        LoraConfig, ThroughputMeter, make_optimizer, make_train_state,
-        make_train_step, make_eval_step, merge_lora, warmup_cosine_schedule)
+        LoraConfig, ThroughputMeter, make_train_state, make_train_step,
+        make_eval_step, merge_lora)
     from gke_ray_train_tpu.train.loop import run_training
     from gke_ray_train_tpu.train.profiling import (
         apply_debug_flags, profiler_from_config)
     from gke_ray_train_tpu.train.step import TrainState
 
+    from gke_ray_train_tpu.config import (
+        audit_config, cadence_from_config, optimizer_from_config,
+        quant_kind_from_config, schedule_from_config)
+
     ctx = get_context()
+    if ctx.is_host0():
+        audit_config(config)   # §5.6: every key honored or warned, never
+                               # silently dropped
     apply_debug_flags(config)
     distributed_init()
     mesh = build_mesh(MeshConfig.from_dict(config))
@@ -159,21 +166,17 @@ def train_loop_per_worker(config: dict):
     # ---- optimizer / adapters ----------------------------------------
     use_lora = bool(config.get("USE_QLORA", False))
     lora_cfg = LoraConfig.from_dict(config) if use_lora else None
-    schedule = warmup_cosine_schedule(
-        float(config.get("LEARNING_RATE", 2e-4)), total_steps,
-        warmup_frac=float(config.get("WARMUP_RATIO", 0.03)))
-    opt = make_optimizer(
-        schedule,
-        weight_decay=float(config.get("WEIGHT_DECAY", 0.001)),
-        clip_norm=float(config.get("MAX_GRAD_NORM", 0.3)))
+    # OPTIM / LR_SCHEDULER_TYPE honored (config.py; reference
+    # fine_tune_config.json:15-17)
+    schedule = schedule_from_config(config, total_steps)
+    opt = optimizer_from_config(config, schedule)
     state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
                              lora_cfg=lora_cfg)
     # QLoRA = LoRA adapters over a *quantized* frozen base (the
     # reference's BitsAndBytesConfig 4-bit NF4 load,
     # fine_tune_llama_ray.py:216-227) — here a pytree transform
     # (ops/quant.py), dequantized inside the jitted forward.
-    quant_kind = str(config.get("QUANT_KIND", "nf4" if use_lora else
-                                "none")).lower()
+    quant_kind = quant_kind_from_config(config, use_lora)
     if use_lora and quant_kind != "none":
         from gke_ray_train_tpu.ops.quant import quantize_params
         params = quantize_params(params, kind=quant_kind)
@@ -187,14 +190,23 @@ def train_loop_per_worker(config: dict):
 
     out_base = config.get("OUTPUT_DIR_BASE", "/tmp/grt_sft")
     sft_dir = os.path.join(out_base, config.get("SFT_SUBDIR_NAME", "sft"))
-    mgr = CheckpointManager(
-        sft_dir, max_to_keep=1,
-        save_interval_steps=int(config.get("SAVE_STEPS_SFT", 50)))
+    # SAVE_STRATEGY / EVALUATION_STRATEGY_SFT honored (config.py;
+    # reference fine_tune_config.json:22-25)
+    cadence = cadence_from_config(config)
+    mgr = None
+    if cadence["save_enabled"]:
+        mgr = CheckpointManager(sft_dir, max_to_keep=1)
+
+    group_by_length = bool(config.get("GROUP_BY_LENGTH", False))
+    if group_by_length and packing:
+        logger.warning("GROUP_BY_LENGTH is redundant under PACKING; "
+                       "packed sequences have no padding to group away")
+        group_by_length = False
 
     def epoch_batches(epoch):
         yield from sft_epoch_batches(
             train_rows, host_batch * n_hosts, num_hosts=n_hosts,
-            host_id=host, epoch=epoch)
+            host_id=host, epoch=epoch, group_by_length=group_by_length)
 
     def eval_fn(st):
         nll = w = 0.0
@@ -226,8 +238,10 @@ def train_loop_per_worker(config: dict):
         log_every=int(config.get("LOGGING_STEPS", 10)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),
-        eval_fn=eval_fn,
-        eval_every=int(config.get("EVAL_STEPS_SFT", 50)),
+        eval_fn=eval_fn if cadence["eval_enabled"] else None,
+        eval_every=cadence["eval_every"],
+        eval_at_epoch_end=cadence["eval_at_epoch_end"],
+        ckpt_every=cadence["ckpt_every"],
         ckpt_view=ckpt_view,
         profiler=profiler_from_config(
             config, os.path.join(out_base, "profile")),

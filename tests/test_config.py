@@ -1,0 +1,165 @@
+"""Config surface audit + honored keys (gke_ray_train_tpu/config.py,
+SURVEY.md §5.6; VERDICT r1 weak #4: no key may be silently ignored)."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from gke_ray_train_tpu.config import (
+    KNOWN_KEYS, audit_config, cadence_from_config, optimizer_from_config,
+    quant_kind_from_config, schedule_from_config)
+
+
+def test_repo_configs_have_no_unknown_keys():
+    import json
+    import os
+    here = os.path.join(os.path.dirname(__file__), "..", "ray-jobs")
+    for name in ("fine_tune_config.json", "fine_tune_config_70b.json"):
+        with open(os.path.join(here, name)) as f:
+            cfg = json.load(f)
+        assert audit_config(cfg) == [], name
+
+
+def test_reference_config_keys_all_known():
+    """Every key the reference ships must be recognized (same API
+    surface, /root/reference/ray-jobs/fine_tune_config.json)."""
+    ref_keys = {
+        "MODEL_ID", "DATASET_NAME", "OUTPUT_DIR_BASE", "USE_QLORA",
+        "LORA_ALPHA", "LORA_DROPOUT", "LORA_R", "BNB_4BIT_COMPUTE_DTYPE",
+        "BNB_4BIT_QUANT_TYPE", "USE_NESTED_QUANT", "NUM_TRAIN_EPOCHS",
+        "PER_DEVICE_TRAIN_BATCH_SIZE", "GRADIENT_ACCUMULATION_STEPS",
+        "LEARNING_RATE", "WEIGHT_DECAY", "OPTIM", "LR_SCHEDULER_TYPE",
+        "MAX_GRAD_NORM", "WARMUP_RATIO", "LOGGING_STEPS", "SAVE_STRATEGY",
+        "SAVE_STEPS_SFT", "EVALUATION_STRATEGY_SFT", "EVAL_STEPS_SFT",
+        "REPORT_TO", "MAX_SEQ_LENGTH", "PACKING", "GROUP_BY_LENGTH",
+        "LLAMA_TARGET_MODULES", "NUM_EVAL_SAMPLES_INFERENCE",
+        "MAX_NEW_GENERATION_TOKENS_INFERENCE", "SFT_SUBDIR_NAME",
+        "MERGED_MODEL_SUBDIR_NAME", "FULL_FT_MODEL_SUBDIR_NAME",
+        "INFERENCE",
+    }
+    assert ref_keys <= KNOWN_KEYS
+
+
+def test_audit_warns_on_unknown(caplog):
+    with caplog.at_level(logging.WARNING):
+        unknown = audit_config({"MODEL_ID": "x", "TYPO_KEY": 1})
+    assert unknown == ["TYPO_KEY"]
+    assert "TYPO_KEY" in caplog.text
+
+
+def test_schedule_kinds():
+    total = 100
+    for kind, at_end in (("cosine", None), ("linear", 0.0),
+                         ("constant_with_warmup", 3e-4)):
+        s = schedule_from_config(
+            {"LR_SCHEDULER_TYPE": kind, "LEARNING_RATE": 3e-4,
+             "WARMUP_RATIO": 0.1}, total)
+        assert float(s(0)) == pytest.approx(0.0, abs=1e-7)
+        peak = float(s(10))
+        assert peak == pytest.approx(3e-4, rel=1e-3)
+        if at_end is not None:
+            assert float(s(total)) == pytest.approx(at_end, abs=1e-8)
+    # HF "constant": flat from step 0, NO warmup ramp
+    s = schedule_from_config({"LR_SCHEDULER_TYPE": "constant",
+                              "LEARNING_RATE": 3e-4, "WARMUP_RATIO": 0.1},
+                             total)
+    assert float(s(0)) == pytest.approx(3e-4)
+    assert float(s(total)) == pytest.approx(3e-4)
+
+
+def test_schedule_unknown_falls_back_to_cosine(caplog):
+    with caplog.at_level(logging.WARNING):
+        s = schedule_from_config({"LR_SCHEDULER_TYPE": "polynomial",
+                                  "LEARNING_RATE": 1e-3}, 50)
+    assert "polynomial" in caplog.text
+    assert float(s(25)) > 0
+
+
+@pytest.mark.parametrize("name", ["adamw", "paged_adamw_32bit",
+                                  "adafactor", "sgd"])
+def test_optimizer_kinds_step(name):
+    opt = optimizer_from_config({"OPTIM": name, "LEARNING_RATE": 1e-3},
+                                1e-3)
+    params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+    st = opt.init(params)
+    g = jax.tree.map(jnp.ones_like, params)
+    upd, _ = opt.update(g, st, params)
+    new = optax.apply_updates(params, upd)
+    assert float(jnp.abs(new["w"] - params["w"]).sum()) > 0
+
+
+def test_optimizer_unknown_warns(caplog):
+    with caplog.at_level(logging.WARNING):
+        optimizer_from_config({"OPTIM": "lion8bit"}, 1e-3)
+    assert "lion8bit" in caplog.text
+
+
+def test_quant_kind_bnb_fallback():
+    assert quant_kind_from_config({}, True) == "nf4"
+    assert quant_kind_from_config({"BNB_4BIT_QUANT_TYPE": "fp4"},
+                                  True) == "fp4"
+    assert quant_kind_from_config({"QUANT_KIND": "int8"}, True) == "int8"
+    assert quant_kind_from_config({}, False) == "none"
+
+
+def test_cadence_strategies():
+    steps = cadence_from_config({"SAVE_STRATEGY": "steps",
+                                 "SAVE_STEPS_SFT": 7,
+                                 "EVALUATION_STRATEGY_SFT": "epoch"})
+    assert steps["ckpt_every"] == 7 and steps["save_enabled"]
+    assert steps["eval_at_epoch_end"] and steps["eval_every"] is None
+    off = cadence_from_config({"SAVE_STRATEGY": "no",
+                               "EVALUATION_STRATEGY_SFT": "no"})
+    assert not off["save_enabled"] and not off["eval_enabled"]
+    epoch = cadence_from_config({"SAVE_STRATEGY": "epoch"})
+    assert epoch["save_enabled"] and epoch["ckpt_every"] is None
+    # typo'd strategies coerce to the warned 'steps' fallback, not to
+    # a silent no-op
+    typo = cadence_from_config({"SAVE_STRATEGY": "stepz",
+                                "EVALUATION_STRATEGY_SFT": "step",
+                                "SAVE_STEPS_SFT": 9, "EVAL_STEPS_SFT": 11})
+    assert typo["ckpt_every"] == 9 and typo["eval_every"] == 11
+
+
+def test_group_by_length_batches():
+    from gke_ray_train_tpu.data.sft import sft_epoch_batches
+    rng = np.random.default_rng(0)
+    n, S = 32, 16
+    lengths = rng.integers(2, S, size=n)
+    inputs = np.zeros((n, S), np.int32)
+    for i, L in enumerate(lengths):
+        inputs[i, :L] = 1 + rng.integers(1, 9, size=L)
+    rows = {"inputs": inputs, "targets": inputs.copy(),
+            "weights": (inputs > 0).astype(np.float32)}
+    batches = list(sft_epoch_batches(rows, 8, group_by_length=True))
+    assert len(batches) == 4
+    # within-batch length spread must be tighter than the global spread
+    spreads = []
+    for b in batches:
+        bl = np.count_nonzero(b["inputs"], axis=1)
+        spreads.append(bl.max() - bl.min())
+    assert np.mean(spreads) < (lengths.max() - lengths.min())
+    # all examples appear exactly once
+    seen = np.concatenate([np.count_nonzero(b["inputs"], axis=1)
+                           for b in batches])
+    assert sorted(seen) == sorted(lengths)
+
+
+def test_empty_epoch_raises_clear_error():
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg = tiny(vocab_size=32, d_model=16, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=32, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt)
+    with pytest.raises(ValueError, match="0 batches"):
+        run_training(state, step, lambda e: iter(()), epochs=1)

@@ -178,3 +178,59 @@ def test_flops_and_meter():
     snap = meter.snapshot()
     assert snap["tokens_per_sec"] > 0
     assert 0 <= snap["mfu"]
+
+
+def test_lora_dropout_active_in_train_step_only():
+    """LORA_DROPOUT (reference fine_tune_config.json:32, VERDICT r1 weak
+    #3): dropout must perturb the train-step loss, vary across steps, and
+    never leak into forward/eval (no rng given)."""
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32", param_dtype="float32")
+    opt = make_optimizer(0.0, clip_norm=None)  # lr=0: params frozen
+    batch = _batch(cfg, jax.random.key(1), B=4, S=16)
+
+    def first_loss(drop):
+        lcfg = LoraConfig(r=4, alpha=8, dropout=drop)
+        state = make_train_state(cfg, opt, jax.random.key(0), lora_cfg=lcfg)
+        # non-zero B so the adapter branch (and its dropout) shows in loss
+        lora = jax.tree.map(
+            lambda x: jnp.ones_like(x) * 0.05
+            if x.shape[-1] != 4 else x, state.lora)
+        state = TrainState(params=state.params, lora=lora,
+                           opt_state=state.opt_state, step=state.step)
+        step = make_train_step(cfg, opt, lora_cfg=lcfg, donate=False)
+        st1, m1 = step(state, batch)
+        _, m2 = step(st1, batch)
+        return float(m1["loss"]), float(m2["loss"])
+
+    base1, base2 = first_loss(0.0)
+    assert base1 == pytest.approx(base2, rel=1e-6)  # lr=0, no dropout
+    d1, d2 = first_loss(0.5)
+    assert d1 != pytest.approx(base1, rel=1e-4)     # dropout perturbs loss
+    assert d1 != pytest.approx(d2, rel=1e-6)        # fresh mask per step
+
+    # forward without an rng stays deterministic regardless of the rate
+    lcfg = LoraConfig(r=4, alpha=8, dropout=0.5)
+    params = init_params(cfg, jax.random.key(0))
+    lora = init_lora(cfg, lcfg, jax.random.key(2))
+    tokens = batch["inputs"]
+    a = forward(params, tokens, cfg, lora=lora, lora_scale=lcfg.scale,
+                lora_dropout=lcfg.dropout)
+    b = forward(params, tokens, cfg, lora=lora, lora_scale=lcfg.scale,
+                lora_dropout=lcfg.dropout)
+    assert jnp.allclose(a, b)
+
+
+def test_lora_dropout_identity_at_rate_zero_with_rng():
+    """rate=0 + rng given must be bit-identical to the no-rng path."""
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32", param_dtype="float32")
+    lcfg = LoraConfig(r=4, alpha=8, dropout=0.0)
+    params = init_params(cfg, jax.random.key(0))
+    lora = init_lora(cfg, lcfg, jax.random.key(2))
+    lora = jax.tree.map(lambda x: jnp.ones_like(x) * 0.1, lora)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    a = forward(params, tokens, cfg, lora=lora, lora_scale=lcfg.scale)
+    b = forward(params, tokens, cfg, lora=lora, lora_scale=lcfg.scale,
+                lora_dropout=0.0, lora_rng=jax.random.key(7))
+    assert jnp.allclose(a, b)
