@@ -10,3 +10,9 @@ from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
     AXIS_CONTEXT,
     MESH_AXES,
 )
+from gke_ray_train_tpu.parallel.placement import (  # noqa: F401
+    host_batch_size,
+    input_shard_layout,
+    make_place_batch,
+    place_batch,
+)

@@ -1,0 +1,126 @@
+"""Multi-host global-batch form-up (SURVEY.md row D9).
+
+The reference gets global-batch semantics from the ``DistributedSampler``
+that Ray Train injects via ``train.torch.prepare_data_loader``
+(/root/reference/ray-jobs/pytorch_llm_ray.py:216): every rank loads its
+1/world_size of each batch and DDP treats the union as the global batch.
+
+The TPU equivalent has one extra step the torch path hides: under
+multi-process JAX, a jitted function sharded over a mesh consumes
+*global* ``jax.Array``s whose shards live across hosts — feeding
+host-local numpy is wrong (and rejected) once ``process_count() > 1``.
+``jax.make_array_from_process_local_data`` is the designed form-up: each
+host contributes its local rows, JAX assembles the global array without
+any cross-host data movement (every host's rows land on its own devices).
+
+Single-host runs take the identical code path (process_count()==1 makes
+local == global), so tests on the 8-fake-device CPU mesh exercise the
+real multi-host logic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gke_ray_train_tpu.parallel.mesh import AXIS_CONTEXT, BATCH_AXES
+
+# batch keys that shard over the sequence axis too when context
+# parallelism is on (token-aligned [B, S] arrays)
+_SEQ_KEYS = ("inputs", "targets", "weights", "segment_ids", "positions")
+
+
+def input_shard_layout(mesh: Mesh) -> Tuple[int, int]:
+    """(shard_count, shard_index): how host input pipelines must
+    partition batch rows for this mesh.
+
+    Processes do NOT always tile the batch axes 1:1 — when the model or
+    context axis spans hosts (e.g. TP across a pod slice), groups of
+    processes address the *same* batch rows and must feed identical
+    data. This computes, from the sharding itself, how many distinct
+    row-groups exist (shard_count) and which one this process belongs to
+    (shard_index) — the TPU-correct generalization of the reference's
+    rank/world_size DistributedSampler split
+    (/root/reference/ray-jobs/pytorch_llm_ray.py:216).
+    """
+    n_tiles = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    sharding = NamedSharding(mesh, P(BATCH_AXES))
+    imap = sharding.devices_indices_map((n_tiles,))
+    groups: Dict[int, set] = {}
+    for d, idx in imap.items():
+        groups.setdefault(d.process_index, set()).add(idx[0].start or 0)
+    distinct = sorted({tuple(sorted(g)) for g in groups.values()})
+    # well-formedness: the distinct row-groups must partition the tiles
+    # into equal shares (place_batch sizes global_B as local_B * count)
+    covered = [t for g in distinct for t in g]
+    if sorted(covered) != list(range(n_tiles)) or \
+            len({len(g) for g in distinct}) != 1:
+        raise ValueError(
+            f"process batch tiles do not evenly partition the batch axis "
+            f"(groups={distinct}); use a standard mesh layout")
+    mine = tuple(sorted(groups[jax.process_index()]))
+    return len(distinct), distinct.index(mine)
+
+
+def place_batch(mesh: Mesh, batch: Dict[str, np.ndarray], *,
+                context_sharded: bool = False,
+                shard_count: Optional[int] = None) -> Dict[str, jax.Array]:
+    """Host-local batch dict [local_B, S] → global sharded arrays
+    [local_B * shard_count, S].
+
+    Every host must call this collectively (SPMD) with equal shapes, the
+    same way every rank's DataLoader yields in the reference. Hosts in
+    the same input shard group (see ``input_shard_layout``) must pass
+    identical data. Non-batch dims always match the local shape: each
+    device slices its model/context portion from its own host's copy, so
+    the pipeline never needs to pre-split sequences.
+    """
+    if shard_count is None:
+        shard_count = input_shard_layout(mesh)[0]
+    seq = AXIS_CONTEXT if context_sharded else None
+    out = {}
+    for k, v in batch.items():
+        v = np.asarray(v)
+        spec = P(BATCH_AXES, seq) if k in _SEQ_KEYS else P(BATCH_AXES)
+        sharding = NamedSharding(mesh, spec)
+        global_shape = (v.shape[0] * shard_count,) + v.shape[1:]
+        out[k] = jax.make_array_from_process_local_data(
+            sharding, v, global_shape)
+    return out
+
+
+def make_place_batch(mesh: Mesh, *, context_sharded: bool = False
+                     ) -> Callable[[Dict[str, np.ndarray]],
+                                   Dict[str, jax.Array]]:
+    """Bind mesh + context flag into the ``place_batch`` hook shape that
+    ``train.loop.run_training`` accepts (layout computed once)."""
+    shard_count, _ = input_shard_layout(mesh)
+
+    def place(batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        return place_batch(mesh, batch, context_sharded=context_sharded,
+                           shard_count=shard_count)
+    return place
+
+
+def host_batch_size(global_batch: int, *,
+                    num_shards: Optional[int] = None,
+                    mesh: Optional[Mesh] = None) -> int:
+    """Rows each input shard must contribute per step. Errors early
+    (with the fix spelled out) instead of letting the form-up fail
+    mid-train. Requires the shard count or the mesh to derive it from —
+    process_count() is NOT a valid default (model/context axes spanning
+    hosts make input shards != processes)."""
+    if num_shards is None and mesh is None:
+        raise TypeError("host_batch_size needs num_shards= or mesh=")
+    n = (num_shards if num_shards is not None
+         else input_shard_layout(mesh)[0])
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {n} input "
+            "shards — pick PER_DEVICE_TRAIN_BATCH_SIZE * mesh data axes "
+            "so every host group contributes the same number of rows")
+    return global_batch // n

@@ -48,6 +48,8 @@ def train_loop_per_worker(config: dict):
         init_params, param_specs, preset_for_model_id, tiny)
     from gke_ray_train_tpu.parallel.mesh import (
         MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.parallel.placement import (
+        host_batch_size, input_shard_layout, make_place_batch)
     from gke_ray_train_tpu.parallel.sharding import tree_shardings
     from gke_ray_train_tpu.rayint import get_context
     from gke_ray_train_tpu.train import (
@@ -148,7 +150,10 @@ def train_loop_per_worker(config: dict):
     grad_accum = int(config.get("GRADIENT_ACCUMULATION_STEPS", 1))
     data_par = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = per_device_batch * data_par * grad_accum
-    host_batch = global_batch // n_hosts
+    # input partitioning follows the mesh, not process_count: hosts
+    # spanned by model/context axes feed identical rows (placement.py)
+    in_shards, in_shard_id = input_shard_layout(mesh)
+    host_batch = host_batch_size(global_batch, num_shards=in_shards)
 
     packing = bool(config.get("PACKING", False))
     if packing:
@@ -205,18 +210,29 @@ def train_loop_per_worker(config: dict):
 
     def epoch_batches(epoch):
         yield from sft_epoch_batches(
-            train_rows, host_batch * n_hosts, num_hosts=n_hosts,
-            host_id=host, epoch=epoch, group_by_length=group_by_length)
+            train_rows, global_batch, num_hosts=in_shards,
+            host_id=in_shard_id, epoch=epoch,
+            group_by_length=group_by_length)
 
     def eval_fn(st):
+        # every host walks the SAME eval rows (each example counted
+        # n_hosts times — the weighted mean is unchanged); partial tail
+        # batches are padded with zero-weight rows so the placed global
+        # shape stays constant (one compiled eval step)
         nll = w = 0.0
         rows = eval_rows
         eb = max(host_batch, 1)
-        for s in range(max(len(rows["inputs"]) // eb, 1)):
+        n_rows = len(rows["inputs"])
+        for s in range(max((n_rows + eb - 1) // eb, 1)):
             b = {k: v[s * eb:(s + 1) * eb] for k, v in rows.items()}
-            if len(b["inputs"]) == 0:
+            got = len(b["inputs"])
+            if got == 0:
                 break
-            n, ww = eval_fn_step(st, b)
+            if got < eb:
+                b = {k: np.concatenate(
+                    [v, np.zeros((eb - got,) + v.shape[1:], v.dtype)])
+                    for k, v in b.items()}
+            n, ww = eval_fn_step(st, place(b))
             nll += float(n); w += float(ww)
         return {"eval_loss": nll / max(w, 1.0)}
 
@@ -232,9 +248,15 @@ def train_loop_per_worker(config: dict):
             lambda st: st._replace(params={}),
             lambda st, v: v._replace(params=st.params),
         )
+    # multi-host batch form-up (SURVEY.md row D9): host-local rows →
+    # global sharded arrays; identical path single-host
+    ctx_sharded = mesh.shape["context"] > 1
+    place = make_place_batch(mesh, context_sharded=ctx_sharded)
+
     state, metrics = run_training(
         state, step_fn, epoch_batches,
         epochs=epochs,
+        place_batch=place,
         log_every=int(config.get("LOGGING_STEPS", 10)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),

@@ -40,6 +40,8 @@ def train_loop_per_worker(config: dict):
     from gke_ray_train_tpu.models import basic_lm
     from gke_ray_train_tpu.parallel.mesh import (
         MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.parallel.placement import (
+        input_shard_layout, make_place_batch)
     from gke_ray_train_tpu.rayint import get_context
     from gke_ray_train_tpu.train import (
         ThroughputMeter, make_optimizer, make_train_state, make_train_step,
@@ -91,8 +93,11 @@ def train_loop_per_worker(config: dict):
     # "max_samples" shrinks further for fast CI smoke
     max_samples = (int(config["max_samples"]) if "max_samples" in config
                    else (16_000 if config.get("test_run", True) else None))
+    # input partitioning follows the mesh (hosts spanned by model/context
+    # axes feed identical rows — parallel/placement.py)
+    in_shards, in_shard_id = input_shard_layout(mesh)
     batches = ShardedBatches(
-        dataset, global_batch, num_hosts=n_hosts, host_id=host,
+        dataset, global_batch, num_hosts=in_shards, host_id=in_shard_id,
         max_samples=max_samples)
 
     epochs = int(config.get("epochs", 1))
@@ -119,6 +124,9 @@ def train_loop_per_worker(config: dict):
     state, metrics = run_training(
         state, step_fn, lambda e: batches.iter_epoch(e),
         epochs=epochs,
+        # host-local rows → global sharded arrays (SURVEY.md row D9)
+        place_batch=make_place_batch(
+            mesh, context_sharded=mesh.shape["context"] > 1),
         log_every=int(config.get("log_every", 20)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),

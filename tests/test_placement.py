@@ -1,0 +1,99 @@
+"""Multi-host batch form-up (parallel/placement.py, SURVEY.md row D9).
+
+Single-process CPU mesh: process_count()==1, so local == global — but the
+code path (make_array_from_process_local_data against the real
+batch_shardings) is exactly what multi-host runs execute.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gke_ray_train_tpu.parallel.mesh import BATCH_AXES, MeshConfig, build_mesh
+from gke_ray_train_tpu.parallel.placement import (
+    host_batch_size, make_place_batch, place_batch)
+from gke_ray_train_tpu.train.step import batch_shardings
+
+
+@pytest.fixture
+def mesh():
+    return build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=1))
+
+
+@pytest.fixture
+def cp_mesh():
+    return build_mesh(MeshConfig(data=2, fsdp=1, model=2, context=2))
+
+
+def _host_batch(B=8, S=16, with_positions=False):
+    b = {
+        "inputs": np.arange(B * S, dtype=np.int32).reshape(B, S) % 97,
+        "targets": np.arange(B * S, dtype=np.int32).reshape(B, S) % 89,
+        "weights": np.ones((B, S), np.float32),
+    }
+    if with_positions:
+        b["positions"] = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        b["segment_ids"] = np.ones((B, S), np.int32)
+    return b
+
+
+def test_placed_batch_matches_batch_shardings(mesh):
+    placed = place_batch(mesh, _host_batch())
+    want = batch_shardings(mesh)
+    for k, arr in placed.items():
+        assert isinstance(arr, jax.Array)
+        assert arr.sharding.is_equivalent_to(want[k], arr.ndim), k
+        assert arr.shape == (8 * jax.process_count(), 16)
+
+
+def test_placed_values_roundtrip(mesh):
+    host = _host_batch()
+    placed = place_batch(mesh, host)
+    for k in host:
+        np.testing.assert_array_equal(np.asarray(placed[k]), host[k])
+
+
+def test_context_sharded_placement(cp_mesh):
+    placed = place_batch(cp_mesh, _host_batch(with_positions=True),
+                         context_sharded=True)
+    want = NamedSharding(cp_mesh, P(BATCH_AXES, "context"))
+    for k in ("inputs", "targets", "weights", "positions", "segment_ids"):
+        assert placed[k].sharding.is_equivalent_to(want, 2), k
+    # a shard holds 1/(data*fsdp) of batch and 1/context of sequence
+    shard = placed["inputs"].addressable_shards[0].data
+    assert shard.shape == (8 // 2, 16 // 2)
+
+
+def test_train_step_consumes_placed_batch(mesh):
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, grad_accum=2)
+    place = make_place_batch(mesh)
+    b = _host_batch(B=8, S=16)
+    b["inputs"] %= 64
+    b["targets"] %= 64
+    state, m = step(state, place(b))
+    assert jnp.isfinite(m["loss"])
+
+
+def test_host_batch_size_divisibility():
+    assert host_batch_size(16, num_shards=4) == 4
+    with pytest.raises(ValueError, match="not divisible"):
+        host_batch_size(10, num_shards=4)
+
+
+def test_input_shard_layout_single_process(mesh, cp_mesh):
+    """One process addresses every batch tile → one input shard."""
+    from gke_ray_train_tpu.parallel.placement import input_shard_layout
+    for m in (mesh, cp_mesh):
+        count, idx = input_shard_layout(m)
+        assert (count, idx) == (1, 0)
