@@ -3,3 +3,6 @@ from gke_ray_train_tpu.models.config import (  # noqa: F401
     tiny, PRESETS, preset_for_model_id)
 from gke_ray_train_tpu.models.transformer import (  # noqa: F401
     init_params, param_specs, forward)
+from gke_ray_train_tpu.models.decode import greedy_generate  # noqa: F401
+from gke_ray_train_tpu.models.kvcache import (  # noqa: F401
+    forward_step, greedy_generate_cached, init_cache)

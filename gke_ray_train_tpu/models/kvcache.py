@@ -1,0 +1,225 @@
+"""KV-cache decode (VERDICT r1 missing #3).
+
+The reference's inference rides HF ``model.generate`` with its built-in
+KV cache (/root/reference/ray-jobs/fine_tune_llama_ray.py:138-146). The
+round-1 decode loop (models/decode.py) recomputes the full O(L²) forward
+per generated token — correct (it is the oracle this module is tested
+against) but unusable at 8B/300-token scale.
+
+TPU design:
+- The cache is a pytree shaped like the scanned block stack
+  ([n_repeats, B, max_len, n_kv_heads, head_dim] per pattern position),
+  so the same ``lax.scan`` that runs training blocks runs decode blocks.
+- One function, ``forward_step``, serves prefill (T = prompt length)
+  and decode (T = 1): new tokens sit at per-row positions
+  ``lens + arange(T)``, their K/V are scattered into the cache, and
+  attention masks by absolute position (kv_pos <= q_pos) — ragged
+  prompts need no compaction, garbage slots from right-padding are
+  overwritten before they ever become visible.
+- Static shapes everywhere: the decode loop is a ``lax.while_loop``
+  over a fixed buffer, one compile per (B, L, max_new) bucket.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import (
+    Params, _lora_entry, _proj)
+from gke_ray_train_tpu.ops.attention import (
+    dot_product_attention, make_attention_mask)
+from gke_ray_train_tpu.ops.norms import rms_norm
+from gke_ray_train_tpu.ops.rope import (
+    apply_rope, rope_frequencies, sinusoidal_positions)
+
+Cache = Dict[str, Any]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int,
+               dtype: Optional[str] = None) -> Cache:
+    """Zeroed cache pytree: blocks[i] = {"k","v"} of
+    [n_repeats, batch, max_len, n_kv_heads, head_dim]."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+    hd = cfg.resolved_head_dim
+    shape = (cfg.n_repeats, batch, max_len, cfg.n_kv_heads, hd)
+    return {"blocks": [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                       for _ in cfg.block_pattern]}
+
+
+def _scatter_rows(cache_kv: jnp.ndarray, new_kv: jnp.ndarray,
+                  lens: jnp.ndarray) -> jnp.ndarray:
+    """Write new_kv [B, T, K, hd] into cache_kv [B, max_len, K, hd] at
+    per-row positions lens[b] + i (ragged scatter via one-hot einsum —
+    XLA lowers this to a masked select for T=1)."""
+    B, T = new_kv.shape[:2]
+    max_len = cache_kv.shape[1]
+    pos = lens[:, None] + jnp.arange(T, dtype=lens.dtype)[None, :]  # [B,T]
+    onehot = (pos[:, :, None] ==
+              jnp.arange(max_len, dtype=lens.dtype)[None, None, :])
+    written = jnp.any(onehot, axis=1)  # [B, max_len]
+    scat = jnp.einsum("btp,btkh->bpkh", onehot.astype(new_kv.dtype), new_kv)
+    return jnp.where(written[:, :, None, None], scat, cache_kv)
+
+
+def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
+                 cache: Cache, lens: jnp.ndarray, *,
+                 lora: Optional[Params] = None,
+                 lora_scale: float = 1.0) -> Tuple[jnp.ndarray, Cache]:
+    """tokens [B, T] at per-row absolute positions lens + arange(T) →
+    (logits [B, T, vocab] fp32, updated cache).
+
+    Same math as transformer.forward restricted to the new tokens, with
+    K/V read from + written to the cache. Supports every family the
+    trainer supports (GQA, RoPE/sinusoidal, sliding-window patterns,
+    softcaps, QTensor bases, LoRA adapters).
+    """
+    B, T = tokens.shape
+    dtype = jnp.dtype(cfg.dtype)
+    eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
+    hd = cfg.resolved_head_dim
+    H, K = cfg.n_heads, cfg.n_kv_heads
+    max_len = cache["blocks"][0]["k"].shape[2]
+
+    positions = lens[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+
+    x = params["embed"].astype(dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.d_model ** 0.5, dtype)
+    if cfg.positional == "sinusoidal":
+        table = jnp.asarray(sinusoidal_positions(cfg.max_seq_len,
+                                                 cfg.d_model))
+        x = x + table.astype(dtype)[jnp.clip(positions, 0,
+                                             cfg.max_seq_len - 1)]
+        rope = None
+    else:
+        rope = jnp.asarray(rope_frequencies(
+            hd, theta=cfg.rope_theta, llama3_scaling=cfg.rope_scaling))
+
+    kv_positions = jnp.broadcast_to(
+        jnp.arange(max_len, dtype=jnp.int32)[None, :], (B, max_len))
+    masks = {}
+    for kind in set(cfg.block_pattern):
+        masks[kind] = make_attention_mask(
+            positions, kv_positions, causal=True,
+            sliding_window=(cfg.sliding_window if kind == "sliding"
+                            else None))
+
+    def repeat_body(x, xs_slice):
+        layer_slice = xs_slice[0]
+        cache_slice = xs_slice[1]
+        lora_slice = xs_slice[2] if lora is not None else None
+        new_cache = []
+        for p, kind in enumerate(cfg.block_pattern):
+            lp = layer_slice[p]
+            ck = cache_slice[p]
+            lo = lora_slice[p] if lora_slice is not None else None
+
+            def lr(name):
+                return _lora_entry(lo, name)
+
+            h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
+            q = _proj(h, lp["wq"], lr("wq"), lora_scale, dtype)
+            k = _proj(h, lp["wk"], lr("wk"), lora_scale, dtype)
+            v = _proj(h, lp["wv"], lr("wv"), lora_scale, dtype)
+            q = q.reshape(B, T, H, hd)
+            k = k.reshape(B, T, K, hd)
+            v = v.reshape(B, T, K, hd)
+            if rope is not None:
+                q = apply_rope(q, positions, rope)
+                k = apply_rope(k, positions, rope)
+            k_cache = _scatter_rows(ck["k"], k.astype(ck["k"].dtype), lens)
+            v_cache = _scatter_rows(ck["v"], v.astype(ck["v"].dtype), lens)
+            out = dot_product_attention(
+                q, k_cache.astype(dtype), v_cache.astype(dtype),
+                masks[kind], scale=cfg.attn_scale,
+                logit_softcap=cfg.attn_softcap)
+            h = _proj(out.reshape(B, T, H * hd), lp["wo"], lr("wo"),
+                      lora_scale, dtype)
+            if cfg.post_block_norm:
+                h = rms_norm(h, lp["attn_post_norm"], eps=eps,
+                             scale_plus_one=sp1)
+            x = x + h
+            h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
+            gate = _proj(h, lp["w_gate"], lr("w_gate"), lora_scale, dtype)
+            up = _proj(h, lp["w_up"], lr("w_up"), lora_scale, dtype)
+            act = (jax.nn.silu(gate) if cfg.activation == "silu"
+                   else jax.nn.gelu(gate, approximate=True))
+            h = _proj(act * up, lp["w_down"], lr("w_down"), lora_scale,
+                      dtype)
+            if cfg.post_block_norm:
+                h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
+                             scale_plus_one=sp1)
+            x = x + h
+            new_cache.append({"k": k_cache, "v": v_cache})
+        return x, new_cache
+
+    xs = [params["blocks"], cache["blocks"]]
+    if lora is not None:
+        xs.append(lora["blocks"])
+    x, new_blocks = jax.lax.scan(repeat_body, x, tuple(xs))
+
+    x = rms_norm(x, params["final_norm"], eps=eps, scale_plus_one=sp1)
+    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+    logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
+                        preferred_element_type=jnp.float32)
+    if cfg.logit_softcap is not None:
+        logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
+    return logits, {"blocks": new_blocks}
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "eos_ids",
+                                   "lora_scale"))
+def greedy_generate_cached(params: Params, prompt: jnp.ndarray,
+                           prompt_len: jnp.ndarray, cfg: ModelConfig, *,
+                           max_new_tokens: int = 64,
+                           eos_ids: Sequence[int] = (),
+                           lora: Optional[Params] = None,
+                           lora_scale: float = 1.0) -> jnp.ndarray:
+    """Drop-in replacement for decode.greedy_generate (same signature,
+    same outputs) running prefill + cached single-token steps.
+
+    prompt: [B, L] right-padded buffer with L >= prompt_len + max_new;
+    the prompt region (L - max_new_tokens) is prefilled in one pass.
+    """
+    B, L = prompt.shape
+    Lp = max(L - max_new_tokens, 1)
+    eos = jnp.asarray(list(eos_ids) or [-1], jnp.int32)
+
+    cache = init_cache(cfg, B, L)
+    logits, cache = forward_step(
+        params, prompt[:, :Lp], cfg, cache,
+        jnp.zeros((B,), jnp.int32), lora=lora, lora_scale=lora_scale)
+    idx = jnp.clip(prompt_len - 1, 0, Lp - 1)
+    cur_tok = jnp.argmax(
+        jnp.take_along_axis(logits, idx[:, None, None], axis=1)[:, 0, :],
+        axis=-1).astype(jnp.int32)
+
+    def cond(state):
+        buf, lens, done, cache, cur_tok, step = state
+        return (step < max_new_tokens) & ~jnp.all(done)
+
+    def body(state):
+        buf, lens, done, cache, cur_tok, step = state
+        write_pos = jnp.clip(lens, 0, L - 1)
+        buf = jnp.where(
+            (~done)[:, None] & (jnp.arange(L)[None, :] ==
+                                write_pos[:, None]),
+            cur_tok[:, None], buf)
+        logits, cache = forward_step(
+            params, cur_tok[:, None], cfg, cache, lens,
+            lora=lora, lora_scale=lora_scale)
+        next_tok = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        now_eos = jnp.any(cur_tok[:, None] == eos[None, :], axis=-1)
+        new_lens = jnp.where(done | (lens >= L), lens, lens + 1)
+        new_done = done | now_eos | (new_lens >= L)
+        return buf, new_lens, new_done, cache, next_tok, step + 1
+
+    buf, _, _, _, _, _ = jax.lax.while_loop(
+        cond, body, (prompt, prompt_len, jnp.zeros((B,), bool), cache,
+                     cur_tok, jnp.asarray(0)))
+    return buf

@@ -1,0 +1,96 @@
+"""KV-cache decode vs the full-forward oracle (models/kvcache.py vs
+models/decode.py; VERDICT r1 missing #3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.models import (
+    forward, greedy_generate, greedy_generate_cached, init_params, tiny)
+from gke_ray_train_tpu.models.kvcache import forward_step, init_cache
+from gke_ray_train_tpu.train.lora import LoraConfig, init_lora
+
+
+def _setup(**kw):
+    cfg = tiny(vocab_size=97, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32", **kw)
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def _ragged_prompts(cfg, B=3, L=48, max_new=16, seed=1):
+    rng = np.random.default_rng(seed)
+    lens = rng.integers(3, L - max_new, size=B).astype(np.int32)
+    buf = np.zeros((B, L), np.int32)
+    for b, n in enumerate(lens):
+        buf[b, :n] = rng.integers(1, cfg.vocab_size, size=n)
+    return jnp.asarray(buf), jnp.asarray(lens)
+
+
+def test_prefill_logits_match_forward():
+    cfg, params = _setup()
+    tokens = jax.random.randint(jax.random.key(1), (2, 24), 0,
+                                cfg.vocab_size)
+    want = forward(params, tokens, cfg)
+    cache = init_cache(cfg, 2, 40)
+    got, cache = forward_step(params, tokens, cfg, cache,
+                              jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_incremental_steps_match_forward():
+    """Feeding tokens one at a time through the cache must reproduce the
+    full-sequence forward logits at every position."""
+    cfg, params = _setup()
+    B, S = 2, 12
+    tokens = jax.random.randint(jax.random.key(2), (B, S), 0,
+                                cfg.vocab_size)
+    want = forward(params, tokens, cfg)
+    cache = init_cache(cfg, B, S)
+    outs = []
+    for t in range(S):
+        lens = jnp.full((B,), t, jnp.int32)
+        logits, cache = forward_step(params, tokens[:, t:t + 1], cfg,
+                                     cache, lens)
+        outs.append(logits[:, 0])
+    got = jnp.stack(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("variant", ["plain", "lora", "sliding",
+                                     "sinusoidal"])
+def test_cached_greedy_matches_oracle(variant):
+    kw = {}
+    if variant == "sliding":
+        kw = dict(block_pattern=("sliding", "global"), sliding_window=8)
+    if variant == "sinusoidal":
+        kw = dict(positional="sinusoidal", tie_embeddings=True)
+    cfg, params = _setup(**kw)
+    lora = lora_scale = None
+    if variant == "lora":
+        lcfg = LoraConfig(r=4, alpha=8)
+        lora = init_lora(cfg, lcfg, jax.random.key(5))
+        lora = jax.tree.map(lambda x: jnp.ones_like(x) * 0.02, lora)
+        lora_scale = lcfg.scale
+    prompt, lens = _ragged_prompts(cfg, max_new=16)
+    kwargs = dict(max_new_tokens=16, eos_ids=(5,))
+    if lora is not None:
+        kwargs.update(lora=lora, lora_scale=lora_scale)
+    want = greedy_generate(params, prompt, lens, cfg, **kwargs)
+    got = greedy_generate_cached(params, prompt, lens, cfg, **kwargs)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cached_greedy_quantized_base():
+    from gke_ray_train_tpu.ops.quant import quantize_params
+    cfg, params = _setup()
+    qparams = quantize_params(params, kind="int8")
+    prompt, lens = _ragged_prompts(cfg, max_new=8)
+    want = greedy_generate(qparams, prompt, lens, cfg, max_new_tokens=8)
+    got = greedy_generate_cached(qparams, prompt, lens, cfg,
+                                 max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
