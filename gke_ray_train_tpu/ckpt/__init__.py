@@ -1,3 +1,5 @@
 from gke_ray_train_tpu.ckpt.manager import CheckpointManager  # noqa: F401
 from gke_ray_train_tpu.ckpt.hf_io import (  # noqa: F401
     load_hf_checkpoint, save_hf_checkpoint)
+from gke_ray_train_tpu.ckpt.hub import (  # noqa: F401
+    acquire_pretrained, fetch_pretrained)

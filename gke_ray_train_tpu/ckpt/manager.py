@@ -68,6 +68,21 @@ class CheckpointManager:
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract))
 
+    def restore_raw(self, step: Optional[int] = None) -> Any:
+        """Topology-free restore: structure/shapes come from checkpoint
+        metadata, everything lands on this host's first device — the
+        offline-converter path, where the save-time mesh (TPU pod) does
+        not exist on the converting machine."""
+        step = step if step is not None else self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        meta = self._mgr.item_metadata(step)
+        sh = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype, sharding=sh),
+            meta, is_leaf=lambda x: hasattr(x, "shape"))
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
     def restore_if_available(self, state_like: Any):
         """(state, resumed_step) — the resume-on-retry behavior the
         reference lacks. Returns (state_like, None) on a fresh start."""

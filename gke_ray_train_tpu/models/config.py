@@ -81,6 +81,20 @@ class ModelConfig:
         if self.attn_impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
 
+    def to_dict(self) -> dict:
+        """JSON-serializable form (offline converter sidecar files)."""
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "ModelConfig":
+        d = dict(d)
+        # JSON turns the normalized tuple-of-pairs rope_scaling into
+        # lists; restore hashability before __post_init__ validation
+        if isinstance(d.get("rope_scaling"), list):
+            d["rope_scaling"] = tuple(
+                tuple(x) for x in d["rope_scaling"])
+        return ModelConfig(**d)
+
     @property
     def resolved_head_dim(self) -> int:
         return self.head_dim or self.d_model // self.n_heads

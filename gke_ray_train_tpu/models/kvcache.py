@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 from gke_ray_train_tpu.models.config import ModelConfig
 from gke_ray_train_tpu.models.transformer import (
-    Params, _lora_entry, _proj)
+    Params, _lora_entry, _mlp, _proj)
 from gke_ray_train_tpu.ops.attention import (
     dot_product_attention, make_attention_mask)
 from gke_ray_train_tpu.ops.norms import rms_norm
@@ -54,16 +54,15 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
 def _scatter_rows(cache_kv: jnp.ndarray, new_kv: jnp.ndarray,
                   lens: jnp.ndarray) -> jnp.ndarray:
     """Write new_kv [B, T, K, hd] into cache_kv [B, max_len, K, hd] at
-    per-row positions lens[b] + i (ragged scatter via one-hot einsum —
-    XLA lowers this to a masked select for T=1)."""
-    B, T = new_kv.shape[:2]
-    max_len = cache_kv.shape[1]
-    pos = lens[:, None] + jnp.arange(T, dtype=lens.dtype)[None, :]  # [B,T]
-    onehot = (pos[:, :, None] ==
-              jnp.arange(max_len, dtype=lens.dtype)[None, None, :])
-    written = jnp.any(onehot, axis=1)  # [B, max_len]
-    scat = jnp.einsum("btp,btkh->bpkh", onehot.astype(new_kv.dtype), new_kv)
-    return jnp.where(written[:, :, None, None], scat, cache_kv)
+    per-row offsets lens[b] — a vmapped dynamic_update_slice: O(T·K·hd)
+    copy per row, no materialized [T, max_len] one-hot.
+
+    dynamic_update_slice clamps out-of-range starts, so a done row whose
+    lens reached max_len re-writes the last slot instead of dropping the
+    write — harmless, nothing is read for done rows."""
+    def upd(c, n, start):
+        return jax.lax.dynamic_update_slice(c, n, (start, 0, 0))
+    return jax.vmap(upd)(cache_kv, new_kv.astype(cache_kv.dtype), lens)
 
 
 def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
@@ -145,12 +144,7 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
                              scale_plus_one=sp1)
             x = x + h
             h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            gate = _proj(h, lp["w_gate"], lr("w_gate"), lora_scale, dtype)
-            up = _proj(h, lp["w_up"], lr("w_up"), lora_scale, dtype)
-            act = (jax.nn.silu(gate) if cfg.activation == "silu"
-                   else jax.nn.gelu(gate, approximate=True))
-            h = _proj(act * up, lp["w_down"], lr("w_down"), lora_scale,
-                      dtype)
+            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
                              scale_plus_one=sp1)

