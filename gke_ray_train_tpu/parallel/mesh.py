@@ -127,8 +127,23 @@ def build_mesh(config: MeshConfig | None = None,
                 f"num_slices ({config.num_slices})")
         per_slice = (config.data // config.num_slices, config.fsdp,
                      config.model, config.context)
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            per_slice, (config.num_slices, 1, 1, 1), devices=devices)
+        if all(getattr(d, "slice_index", None) is not None
+               for d in devices):
+            # real multi-slice hardware: failures here are config bugs
+            # (slice count mismatch etc.) and must surface, not degrade
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                per_slice, (config.num_slices, 1, 1, 1), devices=devices)
+        else:
+            # fake/CPU devices carry no slice_index attribute — emulate
+            # the DCN-outermost layout: contiguous device blocks become
+            # slices, the data axis (outermost, largest stride) spans
+            # them, so only batch-gradient psums cross the slice
+            # boundary (SURVEY.md §5.8)
+            logger.warning(
+                "devices report no slice_index (fake/CPU backend); "
+                "emulating the %d-slice hybrid mesh row-major",
+                config.num_slices)
+            dev_array = np.asarray(devices).reshape(config.shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(

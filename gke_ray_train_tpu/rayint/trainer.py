@@ -37,7 +37,7 @@ except ImportError:
     ray = None
     _HAS_RAY = False
 
-COORDINATOR_PORT = 8476
+DEFAULT_COORDINATOR_PORT = 8476  # fallback when port discovery fails
 
 
 @dataclasses.dataclass
@@ -79,6 +79,9 @@ class RunConfig:
 class Result:
     metrics: Dict[str, Any]
     error: Optional[str] = None
+    # per-worker metrics (worker 0 first); `metrics` is worker 0's view,
+    # matching Ray Train's rank-0 convention, but nothing is dropped
+    worker_metrics: Optional[list] = None
 
 
 def _run_worker(fn: Callable, config: dict, env: Dict[str, str]):
@@ -109,35 +112,80 @@ class JaxTrainer:
         return Result(metrics=metrics)
 
     # -- ray ----------------------------------------------------------
-    def _fit_ray(self) -> Result:  # pragma: no cover - needs a cluster
+    def _fit_ray(self) -> Result:
         if not ray.is_initialized():
             ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
         n = self.scaling.num_workers
         resources = dict(self.scaling.resources_per_worker)
+        num_cpus = resources.pop("CPU", 1)
 
         @ray.remote(max_restarts=0)
         class Worker:
             def node_ip(self):
                 return ray.util.get_node_ip_address()
 
+            def free_port(self):
+                # a port that is free NOW on the coordinator node; the
+                # coordinator binds it moments later (standard
+                # bind-0-release discovery, replaces the fixed 8476 that
+                # collides on shared nodes)
+                import socket
+                s = socket.socket()
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+                s.close()
+                return port
+
             def run(self, fn, config, env):
                 return _run_worker(fn, config, env)
 
-        workers = [
-            Worker.options(resources=resources,
-                           num_cpus=resources.get("CPU", 1)).remote()
-            for _ in range(n)]
-        coord_ip = ray.get(workers[0].node_ip.remote())
-        env_base = {
-            "COORDINATOR_ADDRESS": f"{coord_ip}:{COORDINATOR_PORT}",
-            "NUM_PROCESSES": str(n),
-        }
-        futures = [
-            w.run.remote(self.fn, self.config,
-                         {**env_base, "PROCESS_ID": str(i)})
-            for i, w in enumerate(workers)]
-        results = ray.get(futures)
-        return Result(metrics=results[0])
+        # honor placement_strategy: one bundle per worker, SPREAD puts
+        # each TPU worker on its own host (the declared-but-unused
+        # strategy from round 1)
+        pg = ray.util.placement_group(
+            [dict(resources, CPU=num_cpus) for _ in range(n)],
+            strategy=self.scaling.placement_strategy)
+        try:
+            ray.get(pg.ready())
+            try:
+                from ray.util.scheduling_strategies import (
+                    PlacementGroupSchedulingStrategy)
+
+                def sched(i):
+                    return PlacementGroupSchedulingStrategy(
+                        placement_group=pg, placement_group_bundle_index=i)
+            except ImportError:  # very old ray: best-effort scheduling
+                def sched(i):
+                    return None
+
+            workers = [
+                Worker.options(resources=resources, num_cpus=num_cpus,
+                               scheduling_strategy=sched(i)).remote()
+                for i in range(n)]
+            coord_ip = ray.get(workers[0].node_ip.remote())
+            try:
+                coord_port = int(ray.get(workers[0].free_port.remote()))
+            except Exception:  # noqa: BLE001
+                coord_port = DEFAULT_COORDINATOR_PORT
+            env_base = {
+                "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
+                "NUM_PROCESSES": str(n),
+            }
+            futures = [
+                w.run.remote(self.fn, self.config,
+                             {**env_base, "PROCESS_ID": str(i)})
+                for i, w in enumerate(workers)]
+            results = ray.get(futures)
+        finally:
+            # PGs outlive their Python handles; without removal a retry
+            # attempt would create a second PG against resources the
+            # first still reserves and deadlock in pg.ready()
+            try:
+                ray.util.remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                pass
+        return Result(metrics=results[0] if results else {},
+                      worker_metrics=list(results))
 
     def fit(self) -> Result:
         attempts = self.run_config.failure_config.max_failures + 1

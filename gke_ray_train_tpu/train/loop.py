@@ -46,6 +46,7 @@ def run_training(state: TrainState,
                  place_batch: Optional[Callable] = None,
                  ckpt_view: Optional[tuple] = None,
                  profiler=None,
+                 tb_writer=None,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
@@ -90,6 +91,8 @@ def run_training(state: TrainState,
                 last_metrics = {"epoch": epoch, "step": global_step, **m_host}
                 if meter is not None:
                     last_metrics.update(meter.snapshot())
+                if tb_writer is not None:
+                    tb_writer.log(global_step, last_metrics)
                 if is_host0:
                     logger.info(
                         "epoch %d step %d loss %.4f lr %.3g%s",
@@ -102,6 +105,8 @@ def run_training(state: TrainState,
                     global_step % eval_every == 0:
                 eval_metrics = eval_fn(state)
                 last_metrics.update(eval_metrics)
+                if tb_writer is not None:
+                    tb_writer.log(global_step, eval_metrics)
                 if is_host0:
                     logger.info("eval @ %d: %s", global_step, eval_metrics)
             # SAVE_STRATEGY="steps": mid-epoch checkpoints (HF save_steps
@@ -124,6 +129,9 @@ def run_training(state: TrainState,
             epoch_metrics.update(meter.snapshot())
         if eval_fn is not None and eval_at_epoch_end:
             epoch_metrics.update(eval_fn(state))
+        if tb_writer is not None:
+            tb_writer.log(global_step, epoch_metrics)
+            tb_writer.flush()
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
             ckpt_manager.save(global_step, save_view(state), metrics=m_host)
@@ -134,6 +142,8 @@ def run_training(state: TrainState,
         # profile matters most in exactly that case
         if profiler is not None:
             profiler.close()
+        if tb_writer is not None:
+            tb_writer.close()
 
     if ckpt_manager is not None:
         ckpt_manager.wait()

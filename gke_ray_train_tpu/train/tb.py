@@ -1,0 +1,74 @@
+"""TensorBoard scalar reporting (SURVEY.md row D12 / §5.5; VERDICT r1
+missing #4).
+
+The reference gets TensorBoard events for free from HF Trainer via
+``REPORT_TO: "tensorboard"`` (/root/reference/ray-jobs/
+fine_tune_config.json:26, consumed by SFTConfig). Here a thin writer
+emits the same scalar curves (loss, learning_rate, grad_norm, eval_loss)
+plus the TPU-first metrics the reference never logs (tokens/sec/chip,
+MFU) from host 0 into ``OUTPUT_DIR_BASE`` — dashboard-visible on the
+FUSE mount the RayCluster CR provides.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TensorBoardWriter:
+    """Host-0 scalar event writer; numeric metrics only, silently skips
+    the rest. Never fatal: if no TB backend is importable the writer
+    degrades to a warning + no-op (training must not depend on a
+    dashboard library)."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+        self._w = None
+        try:
+            from tensorboardX import SummaryWriter
+            self._w = SummaryWriter(logdir)
+        except Exception:  # noqa: BLE001
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self._w = SummaryWriter(logdir)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "REPORT_TO=tensorboard but no writer backend "
+                    "importable (%s); scalars will not be logged",
+                    type(e).__name__)
+
+    def log(self, step: int, metrics: dict) -> None:
+        if self._w is None:
+            return
+        for k, v in metrics.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            self._w.add_scalar(k, float(v), global_step=step)
+
+    def flush(self) -> None:
+        if self._w is not None:
+            self._w.flush()
+
+    def close(self) -> None:
+        if self._w is not None:
+            self._w.close()
+            self._w = None
+
+
+def writer_from_config(config: dict, default_dir: str,
+                       is_host0: bool = True) -> Optional[TensorBoardWriter]:
+    """Honor REPORT_TO (reference fine_tune_config.json:26): 'tensorboard'
+    → host-0 writer under OUTPUT_DIR_BASE; 'none'/absent → None."""
+    report_to = str(config.get("REPORT_TO", "none")).lower()
+    if report_to in ("none", "", "null"):
+        return None
+    if report_to != "tensorboard":
+        logger.warning("REPORT_TO=%r not supported (only 'tensorboard' / "
+                       "'none'); disabling reporting", report_to)
+        return None
+    if not is_host0:
+        return None
+    return TensorBoardWriter(default_dir)

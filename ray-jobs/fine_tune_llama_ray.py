@@ -58,6 +58,7 @@ def train_loop_per_worker(config: dict):
     from gke_ray_train_tpu.train.loop import run_training
     from gke_ray_train_tpu.train.profiling import (
         apply_debug_flags, profiler_from_config)
+    from gke_ray_train_tpu.train.tb import writer_from_config
     from gke_ray_train_tpu.train.step import TrainState
 
     from gke_ray_train_tpu.config import (
@@ -291,6 +292,11 @@ def train_loop_per_worker(config: dict):
         ckpt_view=ckpt_view,
         profiler=profiler_from_config(
             config, os.path.join(out_base, "profile")),
+        # REPORT_TO honored (reference fine_tune_config.json:26):
+        # host-0 TB scalars incl. tokens/sec/chip + MFU
+        tb_writer=writer_from_config(
+            config, os.path.join(out_base, "tensorboard"),
+            is_host0=ctx.is_host0()),
         is_host0=ctx.is_host0())
 
     # ---- save final artifacts (HF layout, §5.4) ----------------------

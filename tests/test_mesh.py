@@ -71,3 +71,62 @@ def test_psum_over_mesh(dp_mesh):
 def test_pad_to_multiple():
     assert pad_to_multiple(100, 128) == 128
     assert pad_to_multiple(256, 128) == 256
+
+
+def test_multislice_hybrid_mesh_data_outermost():
+    """num_slices=2 (SURVEY.md §5.8 DCN): mesh builds on fake devices via
+    the emulation fallback; slice blocks are contiguous and the data axis
+    rides across them (only batch psums cross DCN)."""
+    import jax
+    import numpy as np
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    devices = jax.devices()[:8]
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=1,
+                                 num_slices=2), devices)
+    assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "model": 2,
+                                "context": 1}
+    # data index 0 ↔ first contiguous half (slice 0), index 1 ↔ second
+    got0 = [d.id for d in mesh.devices[0].flatten()]
+    got1 = [d.id for d in mesh.devices[1].flatten()]
+    assert sorted(got0) == [d.id for d in devices[:4]]
+    assert sorted(got1) == [d.id for d in devices[4:]]
+
+
+def test_multislice_validation():
+    import jax
+    import pytest
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    with pytest.raises(ValueError, match="divisible by"):
+        build_mesh(MeshConfig(data=3, fsdp=1, model=1, context=1,
+                              num_slices=2), jax.devices()[:3])
+
+
+def test_multislice_train_step_runs():
+    """Full sharded train step over the hybrid mesh (the dryrun variant's
+    core, minus the subprocess)."""
+    import jax
+    import jax.numpy as jnp
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    import numpy as np
+
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=1,
+                                 num_slices=2), jax.devices()[:8])
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, grad_accum=2)
+    place = make_place_batch(mesh)
+    b = {
+        "inputs": np.ones((8, 16), np.int32),
+        "targets": np.ones((8, 16), np.int32),
+        "weights": np.ones((8, 16), np.float32),
+    }
+    state, m = step(state, place(b))
+    assert jnp.isfinite(m["loss"])

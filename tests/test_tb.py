@@ -1,0 +1,81 @@
+"""TensorBoard scalar reporting (train/tb.py, VERDICT r1 missing #4):
+event files must exist and parse back to the logged scalars."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from gke_ray_train_tpu.train.tb import TensorBoardWriter, writer_from_config
+
+
+def _read_scalars(logdir):
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader)
+    out = {}
+    from tensorboard.util import tensor_util
+    for path in glob.glob(os.path.join(logdir, "events.out.tfevents.*")):
+        for event in EventFileLoader(path).Load():
+            for v in getattr(event.summary, "value", []):
+                if v.HasField("tensor"):
+                    val = float(tensor_util.make_ndarray(v.tensor))
+                else:
+                    val = v.simple_value
+                out.setdefault(v.tag, []).append((event.step, val))
+    return out
+
+
+def test_writer_emits_parseable_scalars(tmp_path):
+    logdir = str(tmp_path / "tb")
+    w = TensorBoardWriter(logdir)
+    w.log(10, {"loss": 2.5, "learning_rate": 1e-4, "mfu": 0.41,
+               "note": "not-a-number", "flag": True})
+    w.log(20, {"loss": 2.0, "eval_loss": 2.2})
+    w.close()
+    scalars = _read_scalars(logdir)
+    assert [s for s, _ in scalars["loss"]] == [10, 20]
+    assert abs(scalars["loss"][1][1] - 2.0) < 1e-6
+    assert "mfu" in scalars and "eval_loss" in scalars
+    assert "note" not in scalars and "flag" not in scalars
+
+
+def test_writer_from_config_honors_report_to(tmp_path):
+    assert writer_from_config({}, str(tmp_path)) is None
+    assert writer_from_config({"REPORT_TO": "none"}, str(tmp_path)) is None
+    assert writer_from_config({"REPORT_TO": "wandb"}, str(tmp_path)) is None
+    assert writer_from_config({"REPORT_TO": "tensorboard"}, str(tmp_path),
+                              is_host0=False) is None
+    w = writer_from_config({"REPORT_TO": "tensorboard"}, str(tmp_path))
+    assert w is not None
+    w.close()
+
+
+def test_run_training_writes_events(tmp_path):
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt)
+
+    def batches(epoch):
+        for i in range(4):
+            yield {
+                "inputs": jax.random.randint(jax.random.key(i), (2, 16),
+                                             0, 64),
+                "targets": jax.random.randint(jax.random.key(i + 9),
+                                              (2, 16), 0, 64),
+                "weights": jnp.ones((2, 16), jnp.float32),
+            }
+
+    logdir = str(tmp_path / "tb")
+    w = TensorBoardWriter(logdir)
+    run_training(state, step, batches, epochs=1, log_every=2, tb_writer=w)
+    scalars = _read_scalars(logdir)
+    assert "loss" in scalars and len(scalars["loss"]) >= 2
