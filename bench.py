@@ -1,18 +1,25 @@
 """Benchmark — one JSON line for the driver.
 
-Measures sustained training throughput (tokens/sec/chip) and MFU on the
-attached accelerator(s) for the flagship-architecture model at the
-largest size that fits comfortably, using the real jitted train step
-(loss+grad+clip+adamw, bf16 compute). Timing syncs via a forced
-device→host transfer of the final loss minus the measured tunnel
-round-trip; per-step host timings (and, with Pallas kernels on the
-tunneled TPU, block_until_ready) are unreliable.
+Default mode measures sustained training throughput (tokens/sec/chip)
+and MFU for the flagship-architecture model at the largest size that
+fits comfortably on the attached accelerator(s), using the real jitted
+train step (loss+grad+clip+adamw, bf16 compute).
 
-vs_baseline: ratio against the reference's *published* numbers — the
-reference publishes none (BASELINE.md), so the recorded baseline is this
-framework's own first-light number on this hardware (BASELINE.md table);
-vs_baseline=1.0 marks the establishing run and later rounds report their
-speedup against it.
+Timing methodology (ADVICE r1): BOTH sync methods are measured and
+reported — (a) a forced device→host transfer of the final loss minus
+the measured tunnel round-trip, and (b) ``jax.block_until_ready``. On
+the tunneled dev TPU, (b) has been observed returning before the
+computation finishes (0 ms for a 100+ ms chain), violating its
+contract; (a) cannot lie, so it is the primary number. On hardware
+where both agree, the discrepancy field is ~0 and either is valid.
+
+Extra modes via BENCH_MODE env (recorded in BASELINE.md, not by the
+driver): ``qlora8b`` (full Llama-3.1-8B dims, NF4 frozen base + r=64
+LoRA on one chip), ``seq4k`` (packed 4k-sequence training, BASELINE
+config 5), ``decode`` (KV-cache greedy decode tokens/sec).
+
+vs_baseline: ratio against this framework's own first-light number
+(bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
 """
 
 from __future__ import annotations
@@ -28,22 +35,74 @@ import jax
 import jax.numpy as jnp
 
 
-def main():
+def _measure_latency() -> float:
+    probe = jax.jit(lambda x: x + 1)
+    float(jax.device_get(probe(jnp.zeros(()))))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(jax.device_get(probe(jnp.zeros(()))))
+    return (time.perf_counter() - t0) / 3
+
+
+def _timed_loop(run_steps, steps: int, latency: float):
+    """run_steps(n) executes n chained steps and returns the final
+    device scalar. Returns (dt_device_get, dt_block_until_ready)."""
+    t0 = time.perf_counter()
+    out = run_steps(steps)
+    jax.block_until_ready(out)
+    dt_block = max(time.perf_counter() - t0, 1e-9)
+    t0 = time.perf_counter()
+    out = run_steps(steps)
+    float(jax.device_get(out))
+    dt_get = max(time.perf_counter() - t0 - latency, 1e-9)
+    return dt_get, dt_block
+
+
+def _emit(metric, value, unit, extra, compare_baseline=True):
+    baseline_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
+    baseline = None
+    devices = jax.devices()
+    if compare_baseline and os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                recorded = json.load(f)
+            if recorded.get("device_kind") == devices[0].device_kind:
+                baseline = float(recorded["tokens_per_sec_per_chip"])
+        except (OSError, ValueError, KeyError):
+            pass
+    result = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 3) if baseline else 1.0,
+        **extra,
+    }
+    print(json.dumps(result))
+    on_tpu = devices[0].platform != "cpu"
+    if compare_baseline and baseline is None and on_tpu and \
+            unit == "tokens/sec/chip":
+        with open(baseline_path, "w") as f:
+            json.dump({"device_kind": devices[0].device_kind,
+                       "tokens_per_sec_per_chip": value}, f)
+
+
+def bench_train():
+    """Default driver-recorded bench: 0.69B llama3-arch full train step
+    (identical workload to round 1 for vs_baseline continuity)."""
     import dataclasses
 
     from gke_ray_train_tpu.models import llama3_8b
     from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
     from gke_ray_train_tpu.train import (
-        ThroughputMeter, make_optimizer, make_train_state, make_train_step,
+        make_optimizer, make_train_state, make_train_step,
         train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+    from gke_ray_train_tpu.train.step import batch_shardings
 
     devices = jax.devices()
     n_dev = len(devices)
     on_tpu = devices[0].platform != "cpu"
-
-    # Llama-3 architecture; dims scaled to the attached hardware. On one
-    # v5e chip (16 GB HBM): fp32 params + fp32 adam mu/nu = 12 bytes/param
-    # → ~0.7B params leaves room for bf16 activations at B=8, S=1024.
     if on_tpu:
         size = dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
                     d_ff=5504, vocab_size=32768)
@@ -69,66 +128,243 @@ def main():
                                       cfg.vocab_size),
         "weights": jnp.ones((B, S), jnp.float32),
     }
-    from gke_ray_train_tpu.train.step import batch_shardings
     batch = jax.device_put(batch, batch_shardings(mesh))
 
-    # warmup/compile
+    state, m = step(state, batch)  # compile
+    float(jax.device_get(m["loss"]))
+    latency = _measure_latency()
+
+    holder = {"state": state, "m": m}
+
+    def run_steps(n):
+        for _ in range(n):
+            holder["state"], holder["m"] = step(holder["state"], batch)
+        return holder["m"]["loss"]
+
+    dt_get, dt_block = _timed_loop(run_steps, steps, latency)
+    tokens = B * S * steps
+    tps_chip = tokens / dt_get / n_dev
+    mfu = (tokens / dt_get) * train_flops_per_token(cfg, S) / (
+        peak_flops_per_device() * n_dev)
+    _emit(
+        "tokens/sec/chip llama3-arch causal-LM train step "
+        f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, bf16, "
+        f"{devices[0].device_kind} x{n_dev})",
+        tps_chip, "tokens/sec/chip",
+        {"mfu": round(mfu, 4),
+         "loss": round(float(jax.device_get(holder['m']['loss'])), 4),
+         "timing": {"device_get_s": round(dt_get, 4),
+                    "block_until_ready_s": round(dt_block, 4)}})
+
+
+def _quantized_llama8b_params(cfg, kind="nf4"):
+    """Build the quantized frozen base DIRECTLY (per-repeat slices) —
+    materializing 8B fp32/bf16 params first would blow the 16 GB chip."""
+    from gke_ray_train_tpu.models import init_params
+    from gke_ray_train_tpu.ops.quant import (
+        QTensor, QUANT_TARGETS, quantize_tensor)
+
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k),
+                            jax.random.key(0))
+    key = jax.random.key(0)
+    counter = [0]
+
+    def leaf(path, sd):
+        counter[0] += 1
+        k = jax.random.fold_in(key, counter[0])
+        name = next((p.key for p in reversed(path)
+                     if hasattr(p, "key")), "")
+        if name in QUANT_TARGETS and len(sd.shape) == 3:
+            parts = []
+            for r in range(sd.shape[0]):
+                w = jax.random.normal(jax.random.fold_in(k, r),
+                                      sd.shape[1:], jnp.bfloat16) * 0.02
+                parts.append(quantize_tensor(w[None], kind))
+            return QTensor(
+                jnp.concatenate([p.codes for p in parts]),
+                jnp.concatenate([p.scales for p in parts]),
+                parts[0].kind, parts[0].group)
+        return jax.random.normal(k, sd.shape, jnp.bfloat16) * 0.02
+
+    return jax.tree_util.tree_map_with_path(leaf, shapes)
+
+
+def bench_qlora8b():
+    """Flagship size on one chip: Llama-3.1-8B dims, NF4 frozen base,
+    r=64 LoRA adapters trained (the reference's exact QLoRA workload,
+    fine_tune_config.json)."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import llama3_8b
+    from gke_ray_train_tpu.train import (
+        LoraConfig, make_optimizer, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.lora import init_lora
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+    from gke_ray_train_tpu.train.step import TrainState
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    B, S, steps = 4, 1024, 10
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="bfloat16", remat=True)
+
+    params = _quantized_llama8b_params(cfg)
+    lcfg = LoraConfig(r=64, alpha=16)
+    lora = init_lora(cfg, lcfg, jax.random.key(1))
+    schedule = warmup_cosine_schedule(2e-4, 1000)
+    opt = make_optimizer(schedule)
+    opt_state = jax.jit(opt.init)(lora)
+    state = TrainState(params=params, lora=lora, opt_state=opt_state,
+                       step=jnp.zeros((), jnp.int32))
+    step = make_train_step(cfg, opt, lora_cfg=lcfg, schedule=schedule)
+
+    batch = {
+        "inputs": jax.random.randint(jax.random.key(2), (B, S), 0,
+                                     cfg.vocab_size),
+        "targets": jax.random.randint(jax.random.key(3), (B, S), 0,
+                                      cfg.vocab_size),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
     state, m = step(state, batch)
     float(jax.device_get(m["loss"]))
+    latency = _measure_latency()
+    holder = {"state": state, "m": m}
 
-    # Timing: a forced device->host transfer of the last step's loss is
-    # the sync point — on the tunneled TPU, block_until_ready can return
-    # before the chain finishes (observed with Pallas kernels), while a
-    # value transfer cannot lie. Subtract the measured tunnel round-trip
-    # so latency isn't billed to the train step.
-    lat_probe = jax.jit(lambda x: x + 1)
-    float(jax.device_get(lat_probe(jnp.zeros(()))))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(jax.device_get(lat_probe(jnp.zeros(()))))
-    latency = (time.perf_counter() - t0) / 3
+    def run_steps(n):
+        for _ in range(n):
+            holder["state"], holder["m"] = step(holder["state"], batch)
+        return holder["m"]["loss"]
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, m = step(state, batch)
-    last_loss = float(jax.device_get(m["loss"]))
-    dt = max(time.perf_counter() - t0 - latency, 1e-9)
-
+    dt_get, dt_block = _timed_loop(run_steps, steps, latency)
     tokens = B * S * steps
-    tps_chip = tokens / dt / n_dev
-    meter = ThroughputMeter(cfg, seq_len=S, n_devices=n_dev)
-    mfu = (tokens / dt) * train_flops_per_token(cfg, S) / (
-        meter.peak_flops * n_dev)
+    tps_chip = tokens / dt_get / n_dev
+    mfu = (tokens / dt_get) * train_flops_per_token(
+        cfg, S, trainable="lora") / (peak_flops_per_device() * n_dev)
+    _emit(
+        f"tokens/sec/chip Llama-3.1-8B QLoRA (NF4 base, r=64) seq {S} "
+        f"({devices[0].device_kind} x{n_dev})",
+        tps_chip, "tokens/sec/chip",
+        {"mfu_lora_flops": round(mfu, 4),
+         "loss": round(float(jax.device_get(holder['m']['loss'])), 4),
+         "timing": {"device_get_s": round(dt_get, 4),
+                    "block_until_ready_s": round(dt_block, 4)}},
+        compare_baseline=False)
 
-    baseline_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "bench_baseline.json")
-    baseline = None
-    if os.path.exists(baseline_path):
-        try:
-            with open(baseline_path) as f:
-                recorded = json.load(f)
-            if recorded.get("device_kind") == devices[0].device_kind:
-                baseline = float(recorded["tokens_per_sec_per_chip"])
-        except (OSError, ValueError, KeyError):
-            pass
 
-    result = {
-        "metric": "tokens/sec/chip llama3-arch causal-LM train step "
-                  f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, bf16, "
-                  f"{devices[0].device_kind} x{n_dev})",
-        "value": round(tps_chip, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps_chip / baseline, 3) if baseline else 1.0,
-        "mfu": round(mfu, 4),
-        "loss": round(last_loss, 4),
+def bench_seq4k():
+    """BASELINE config 5 shape: packed 4k sequences (segment-ID masks),
+    proxy-size model, flash attention."""
+    import dataclasses
+    import numpy as np
+
+    from gke_ray_train_tpu.models import llama3_8b
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    B, S, steps = (2, 4096, 10) if on_tpu else (2, 512, 2)
+    size = (dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+                 d_ff=5504, vocab_size=32768) if on_tpu else
+            dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                 d_ff=512, vocab_size=2048))
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-seq4k-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True, **size)
+
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, schedule=schedule)
+
+    # packed rows: 4 documents per row, positions restart per segment
+    seg_len = S // 4
+    seg = np.repeat(np.arange(1, 5), seg_len)[None, :].repeat(B, 0)
+    pos = np.tile(np.arange(seg_len), 4)[None, :].repeat(B, 0)
+    batch = {
+        "inputs": jax.random.randint(jax.random.key(1), (B, S), 0,
+                                     cfg.vocab_size),
+        "targets": jax.random.randint(jax.random.key(2), (B, S), 0,
+                                      cfg.vocab_size),
+        "weights": jnp.ones((B, S), jnp.float32),
+        "segment_ids": jnp.asarray(seg, jnp.int32),
+        "positions": jnp.asarray(pos, jnp.int32),
     }
-    print(json.dumps(result))
+    state, m = step(state, batch)
+    float(jax.device_get(m["loss"]))
+    latency = _measure_latency()
+    holder = {"state": state, "m": m}
 
-    if baseline is None and on_tpu:
-        with open(baseline_path, "w") as f:
-            json.dump({"device_kind": devices[0].device_kind,
-                       "tokens_per_sec_per_chip": tps_chip,
-                       "mfu": mfu}, f)
+    def run_steps(n):
+        for _ in range(n):
+            holder["state"], holder["m"] = step(holder["state"], batch)
+        return holder["m"]["loss"]
+
+    dt_get, dt_block = _timed_loop(run_steps, steps, latency)
+    tokens = B * S * steps
+    tps_chip = tokens / dt_get / n_dev
+    # packed rows attend within segments only: attention FLOPs scale
+    # with the segment length, not the packed row length
+    mfu = (tokens / dt_get) * train_flops_per_token(cfg, seg_len) / (
+        peak_flops_per_device() * n_dev)
+    _emit(
+        f"tokens/sec/chip packed-seq{S} train step "
+        f"({devices[0].device_kind} x{n_dev})",
+        tps_chip, "tokens/sec/chip",
+        {"mfu": round(mfu, 4),
+         "timing": {"device_get_s": round(dt_get, 4),
+                    "block_until_ready_s": round(dt_block, 4)}},
+        compare_baseline=False)
+
+
+def bench_decode():
+    """KV-cache greedy decode tokens/sec (models/kvcache.py)."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import greedy_generate_cached, llama3_8b
+    from gke_ray_train_tpu.models import init_params
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-decode-bench",
+        d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8, d_ff=5504,
+        vocab_size=32768, max_seq_len=1024,
+        dtype="bfloat16", param_dtype="bfloat16", remat=False)
+    if not on_tpu:
+        cfg = dataclasses.replace(cfg, d_model=256, n_layers=2, n_heads=4,
+                                  n_kv_heads=2, d_ff=512, vocab_size=2048)
+    params = init_params(cfg, jax.random.key(0))
+    B, Lp, new = 1, 512, 128
+    prompt = jnp.zeros((B, Lp + new), jnp.int32).at[:, :Lp].set(
+        jax.random.randint(jax.random.key(1), (B, Lp), 1, cfg.vocab_size))
+    lens = jnp.full((B,), Lp, jnp.int32)
+
+    out = greedy_generate_cached(params, prompt, lens, cfg,
+                                 max_new_tokens=new)
+    jax.device_get(out)
+    latency = _measure_latency()
+    t0 = time.perf_counter()
+    out = greedy_generate_cached(params, prompt, lens, cfg,
+                                 max_new_tokens=new)
+    jax.device_get(out)
+    dt = max(time.perf_counter() - t0 - latency, 1e-9)
+    _emit(
+        f"decode tokens/sec KV-cache greedy ({cfg.d_model}d/"
+        f"{cfg.n_layers}L, prompt {Lp} + {new} new, "
+        f"{devices[0].device_kind})",
+        new * B / dt, "tokens/sec", {}, compare_baseline=False)
+
+
+def main():
+    mode = os.environ.get("BENCH_MODE", "train")
+    {"train": bench_train, "qlora8b": bench_qlora8b,
+     "seq4k": bench_seq4k, "decode": bench_decode}[mode]()
 
 
 if __name__ == "__main__":

@@ -80,13 +80,22 @@ def _open_shards(model_dir: str):
 
 
 def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
-                       mesh=None) -> Params:
-    """Stream HF safetensors into the (optionally mesh-sharded) pytree."""
+                       mesh=None,
+                       quantize: Optional[str] = None) -> Params:
+    """Stream HF safetensors into the (optionally mesh-sharded) pytree.
+
+    ``quantize``: "nf4" | "int8" — quantize the projection matrices
+    *during* the stream (one layer-slice at a time, quantized result
+    held in host RAM), so an 8B QLoRA base loads onto a single 16 GB
+    chip without the full-precision tree ever existing on device. The
+    equivalent of the reference loading with BitsAndBytesConfig
+    (fine_tune_llama_ray.py:216-227,240). Norms/embed/lm_head stay full
+    precision, like bnb.
+    """
     from safetensors import safe_open
 
-    files = _open_shards(model_dir)
-    shardings = (tree_shardings(mesh, param_specs(cfg))
-                 if mesh is not None else None)
+    specs = param_specs(cfg)
+    shardings = (tree_shardings(mesh, specs) if mesh is not None else None)
     pdt = jnp.dtype(cfg.param_dtype)
     P_ = len(cfg.block_pattern)
     R = cfg.n_repeats
@@ -99,18 +108,47 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
         # bf16 tensors come back as ml_dtypes.bfloat16, which jnp converts
         return np.asarray(handles[path].get_tensor(tname))
 
+    files = _open_shards(model_dir)
+
     def place(arr: np.ndarray, spec_path) -> jax.Array:
         arr = jnp.asarray(arr, pdt)
         if shardings is None:
             return arr
         return jax.device_put(arr, spec_path)
 
+    def load_quantized(p: int, key: str):
+        """Per-layer-slice quantize: device sees one [1, D, F] slice at
+        a time; codes/scales accumulate in host RAM, then placed."""
+        from gke_ray_train_tpu.ops.quant import (
+            QTensor, quant_specs, quantize_tensor)
+        codes_l, scales_l = [], []
+        kind = group = None
+        for r in range(R):
+            w = _maybe_t(read(_hf_layer_names(cfg, r * P_ + p)[key]), key)
+            qt = quantize_tensor(jnp.asarray(w, jnp.bfloat16)[None],
+                                 quantize)
+            kind, group = qt.kind, qt.group
+            codes_l.append(np.asarray(jax.device_get(qt.codes)))
+            scales_l.append(np.asarray(jax.device_get(qt.scales)))
+            del qt
+        host_qt = QTensor(np.concatenate(codes_l),
+                          np.concatenate(scales_l), kind, group)
+        if mesh is None:
+            return QTensor(jnp.asarray(host_qt.codes),
+                           jnp.asarray(host_qt.scales), kind, group)
+        q_spec = quant_specs(specs["blocks"][p][key], host_qt, mesh)
+        return jax.device_put(host_qt, tree_shardings(mesh, q_spec))
+
     # per-(pattern-position, key): gather the R per-layer tensors, stack
+    from gke_ray_train_tpu.train.lora import ALL_TARGETS as _PROJ_KEYS
     blocks = []
     for p in range(P_):
         blk: Dict[str, jax.Array] = {}
         keys = _hf_layer_names(cfg, 0).keys()
         for key in keys:
+            if quantize and key in _PROJ_KEYS:
+                blk[key] = load_quantized(p, key)
+                continue
             stacked = np.stack([
                 _maybe_t(read(_hf_layer_names(cfg, r * P_ + p)[key]), key)
                 for r in range(R)])

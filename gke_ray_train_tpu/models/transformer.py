@@ -16,6 +16,7 @@ Sharding (SURVEY.md §2c, TPU build disposition):
 
 from __future__ import annotations
 
+import logging
 import math
 from typing import Any, Dict, Optional
 
@@ -33,6 +34,19 @@ from gke_ray_train_tpu.ops.rope import (
 from gke_ray_train_tpu.parallel.mesh import AXIS_CONTEXT, BATCH_AXES
 
 Params = Dict[str, Any]
+
+logger = logging.getLogger(__name__)
+_flash_fallback_warned: set = set()
+
+
+def _warn_flash_fallback(seq_len: int) -> None:
+    """Once per sequence length (trace-time, not per step)."""
+    if seq_len not in _flash_fallback_warned:
+        _flash_fallback_warned.add(seq_len)
+        logger.warning(
+            "attn_impl='flash' but seq_len=%d is not a 128 multiple — "
+            "falling back to the O(S^2) dense-mask XLA path; pad the "
+            "sequence to a 128 multiple to keep the kernel", seq_len)
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +286,9 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     if impl == "flash" and S % 128 != 0:
         # flash needs a 128-multiple sequence to tile; odd eval/infer
         # lengths fall back to the dense-mask oracle instead of crashing
+        # — loudly, since the O(S²) memory/speed hit is easy to miss
+        # (ADVICE r1: silent fallback)
+        _warn_flash_fallback(S)
         impl = "xla"
 
     # dense masks are shared by every layer of the same kind — build once.

@@ -62,11 +62,26 @@ def run_training(state: TrainState,
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
     load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
     if ckpt_manager is not None:
-        view, resumed = ckpt_manager.restore_if_available(save_view(state))
-        if resumed is not None:
-            state = load_view(state, view)
-            if is_host0:
-                logger.info("resumed at step %d", resumed)
+        try:
+            view, resumed = ckpt_manager.restore_if_available(
+                save_view(state))
+            if resumed is not None:
+                state = load_view(state, view)
+        except Exception as e:  # noqa: BLE001 - layout-mismatch fallback
+            if ckpt_view is None:
+                raise
+            # a checkpoint written before the view existed stores the
+            # FULL state (ADVICE r1: pre-view LoRA checkpoints must stay
+            # restorable) — retry against the full-state template
+            logger.warning(
+                "ckpt_view restore failed (%s: %s); retrying as a "
+                "full-state checkpoint (pre-view layout)",
+                type(e).__name__, e)
+            full, resumed = ckpt_manager.restore_if_available(state)
+            if resumed is not None:
+                state = full
+        if resumed is not None and is_host0:
+            logger.info("resumed at step %d", resumed)
 
     last_metrics = {}
     global_step = int(jax.device_get(state.step))

@@ -37,14 +37,20 @@ def peak_flops_per_device(default: float = 197e12) -> float:
     return default
 
 
-def train_flops_per_token(cfg: ModelConfig, seq_len: int) -> float:
-    """6*N_params for the dense matmuls (fwd 2N + bwd 4N) plus the
-    attention term 12 * n_layers * d_attn * seq (QK^T and AV, fwd+bwd),
-    halved for causal masking."""
+def train_flops_per_token(cfg: ModelConfig, seq_len: int, *,
+                          trainable: str = "full") -> float:
+    """Dense matmuls: fwd 2N + bwd 4N (= 2N weight-grad + 2N act-grad)
+    plus the attention term 12 * n_layers * d_attn * seq (QK^T and AV,
+    fwd+bwd), halved for causal masking.
+
+    trainable="lora": the frozen base skips its weight-grad matmuls
+    (4N instead of 6N; adapter FLOPs are negligible at r<<d) — using
+    the full-train count would overstate QLoRA MFU by ~1.5x."""
     n = cfg.param_count()
+    dense = (4.0 if trainable == "lora" else 6.0) * n
     d_attn = cfg.n_heads * cfg.resolved_head_dim
     attn = 12 * cfg.n_layers * d_attn * seq_len * 0.5
-    return 6.0 * n + attn
+    return dense + attn
 
 
 @dataclasses.dataclass

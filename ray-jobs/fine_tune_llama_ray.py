@@ -124,9 +124,20 @@ def train_loop_per_worker(config: dict):
         ckpt_dir = acquire_pretrained(model_id, token=hf_token,
                                       num_hosts=n_hosts, host_id=host)
         have_local = ckpt_dir is not None
+    use_lora = bool(config.get("USE_QLORA", False))
+    quant_kind = quant_kind_from_config(config, use_lora)
+    load_quant = quant_kind if (use_lora and quant_kind != "none") else None
+    already_quantized = False
     if have_local:
-        params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
-        logger.info("loaded pretrained weights from %s", ckpt_dir)
+        # QLoRA bases quantize DURING the stream (one layer-slice on
+        # device at a time) — 8B fits a single 16 GB chip this way, the
+        # same shape as the reference's BitsAndBytesConfig load
+        params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh,
+                                    quantize=load_quant)
+        already_quantized = load_quant is not None
+        logger.info("loaded pretrained weights from %s%s", ckpt_dir,
+                    f" (quantized {load_quant} on load)" if load_quant
+                    else "")
     else:
         if not smoke:
             logger.warning(
@@ -194,7 +205,6 @@ def train_loop_per_worker(config: dict):
     total_steps = steps_per_epoch * epochs
 
     # ---- optimizer / adapters ----------------------------------------
-    use_lora = bool(config.get("USE_QLORA", False))
     lora_cfg = LoraConfig.from_dict(config) if use_lora else None
     # OPTIM / LR_SCHEDULER_TYPE honored (config.py; reference
     # fine_tune_config.json:15-17)
@@ -206,8 +216,7 @@ def train_loop_per_worker(config: dict):
     # reference's BitsAndBytesConfig 4-bit NF4 load,
     # fine_tune_llama_ray.py:216-227) — here a pytree transform
     # (ops/quant.py), dequantized inside the jitted forward.
-    quant_kind = quant_kind_from_config(config, use_lora)
-    if use_lora and quant_kind != "none":
+    if use_lora and quant_kind != "none" and not already_quantized:
         from gke_ray_train_tpu.ops.quant import quantize_params
         params = quantize_params(params, kind=quant_kind)
         logger.info("quantized frozen base weights to %s", quant_kind)

@@ -96,3 +96,54 @@ def test_hf_roundtrip_gemma_pattern_sharded(tmp_path, fsdp_mesh):
         np.asarray(forward(params, tokens, cfg)),
         np.asarray(forward(jax.device_get(loaded) and loaded, tokens, cfg)),
         atol=0.05)  # bf16 export quantization
+
+
+def test_lora_ckpt_view_restores_pre_view_full_checkpoint(tmp_path):
+    """ADVICE r1: a checkpoint written BEFORE the LoRA ckpt_view existed
+    holds the full state (params included); resuming with the view
+    configured must fall back to a full-state restore, not crash."""
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.train import LoraConfig, make_train_step
+    from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.step import TrainState
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    lcfg = LoraConfig(r=4, alpha=8)
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), lora_cfg=lcfg)
+
+    # old-layout checkpoint: FULL state, no view applied
+    d = str(tmp_path / "sft")
+    mgr = CheckpointManager(d, async_save=False)
+    marked = TrainState(params=state.params,
+                        lora=jax.tree.map(lambda x: x + 1.0, state.lora),
+                        opt_state=state.opt_state,
+                        step=jnp.asarray(41, jnp.int32))
+    mgr.save(41, marked, metrics={"loss": 1.0}, force=True)
+    mgr.wait()
+    mgr.close()
+
+    step_fn = make_train_step(cfg, opt, lora_cfg=lcfg, donate=False)
+    ckpt_view = (
+        lambda st: st._replace(params={}),
+        lambda st, v: v._replace(params=st.params),
+    )
+
+    def one_batch(epoch):
+        yield {
+            "inputs": jax.random.randint(jax.random.key(1), (2, 8), 0, 64),
+            "targets": jax.random.randint(jax.random.key(2), (2, 8), 0, 64),
+            "weights": jnp.ones((2, 8), jnp.float32),
+        }
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    final, metrics = run_training(state, step_fn, one_batch, epochs=1,
+                                  ckpt_manager=mgr2, ckpt_view=ckpt_view)
+    mgr2.close()
+    # resumed from step 41 (then +1 step), with the marked lora restored
+    assert int(final.step) == 42
+    lo = jax.tree.leaves(final.lora)[0]
+    base = jax.tree.leaves(state.lora)[0]
+    assert not jnp.allclose(lo, base)

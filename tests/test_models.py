@@ -190,3 +190,21 @@ def test_preset_lookup():
 def test_big_config_param_counts():
     assert llama3_8b().param_count() == pytest.approx(8.03e9, rel=0.02)
     assert gemma2_9b().param_count() == pytest.approx(9.2e9, rel=0.05)
+
+
+def test_flash_fallback_warns_once(caplog):
+    """ADVICE r1: the flash->dense fallback for non-128-multiple seq
+    lengths must warn (once per length), not silently lose the kernel."""
+    import logging
+    from gke_ray_train_tpu.models.transformer import _flash_fallback_warned
+    _flash_fallback_warned.clear()
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32", attn_impl="flash")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 100), 0, 64)
+    with caplog.at_level(logging.WARNING):
+        forward(params, tokens, cfg)
+        forward(params, tokens, cfg)
+    hits = [r for r in caplog.records if "128 multiple" in r.message]
+    assert len(hits) == 1
