@@ -1,16 +1,28 @@
 """Side-by-side base-vs-tuned inference comparison (SURVEY.md §3.4).
 
 Capability parity with run_inference_comparison
-(ray-jobs/fine_tune_llama_ray.py:22-194): host-0-only, post-training;
-filter test rows, greedy-generate from both the original and the
-fine-tuned weights with a shared prompt template, print and accumulate
-side-by-side results, JSON-dump to shared storage. TPU redesign: both
-models generate through one jitted KV-cached prefill+step loop
-(models/kvcache.py; models/decode.py is the full-forward oracle it is
-tested against), prompts bucketed to 128-multiples so similar lengths
-share a compile; no device cache juggling (the reference's del model +
+(ray-jobs/fine_tune_llama_ray.py:22-194): post-training; filter test
+rows, greedy-generate from both the original and the fine-tuned weights
+with a shared prompt template, print and accumulate side-by-side
+results, JSON-dump to shared storage. TPU redesign: both models generate
+through one jitted KV-cached prefill+step loop (models/kvcache.py;
+models/decode.py is the full-forward oracle it is tested against),
+prompts bucketed to 128-multiples so similar lengths share a compile; no
+device cache juggling (the reference's del model +
 torch.cuda.empty_cache() dance at :191-194 has no XLA equivalent — arrays
 free when references drop).
+
+Multi-host semantics (the one place this deliberately diverges from the
+reference's rank-0-only harness, :22-194): the reference can generate on
+rank 0 alone because DDP replicates weights; here the weights are
+mesh-sharded global arrays, so EVERY host must enter the generate —
+running it on host 0 only would diverge the SPMD program and deadlock.
+``is_host0`` therefore gates only printing and file IO, exactly like
+train/loop.py. Pass ``mesh`` whenever params are sharded over one: the
+prompt buffers are formed up as globally-replicated arrays (every host
+feeds identical bytes — callers must pass identical ``test_rows``, which
+holds for the seeded downsample/synthetic paths) and the generated
+buffer is read back from an addressable replica shard.
 """
 
 from __future__ import annotations
@@ -18,10 +30,13 @@ from __future__ import annotations
 import json
 import logging
 import os
-from typing import Callable, Dict, List, Optional
+from functools import lru_cache
+from typing import Callable, Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gke_ray_train_tpu.data.sft import format_gretel_sql_example, render_chat
 from gke_ray_train_tpu.models.config import ModelConfig
@@ -38,10 +53,37 @@ def _prompt_bucket(n: int, *, bucket: int = 128) -> int:
     return max(bucket, ((n + bucket - 1) // bucket) * bucket)
 
 
+@lru_cache(maxsize=32)
+def _replicated_generate(mesh: Mesh, cfg: ModelConfig,
+                         max_new_tokens: int, eos_ids: Tuple[int, ...],
+                         lora_scale: float):
+    """One jitted generate per (mesh, cfg, decode-shape) with the output
+    pinned to a replicated sharding, so every host can read its full
+    value from any addressable shard. The inner call traces through the
+    already-jitted greedy_generate_cached."""
+    out_sharding = NamedSharding(mesh, P())
+
+    def f(params, prompt, prompt_len, lora):
+        return greedy_generate_cached(
+            params, prompt, prompt_len, cfg,
+            max_new_tokens=max_new_tokens, eos_ids=eos_ids,
+            lora=lora, lora_scale=lora_scale)
+    return jax.jit(f, out_shardings=out_sharding)
+
+
+def _place_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """Host-local numpy (identical on every host) → globally-replicated
+    jax.Array over the mesh (the form-up greedy decode needs once params
+    are sharded; single-host this is a plain device_put)."""
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P()), arr, arr.shape)
+
+
 def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
                     prompt_text: str, *, max_new_tokens: int = 300,
                     lora: Optional[Params] = None,
-                    lora_scale: float = 1.0) -> str:
+                    lora_scale: float = 1.0,
+                    mesh: Optional[Mesh] = None) -> str:
     ids = np.asarray(
         tokenizer(prompt_text, add_special_tokens=False)["input_ids"],
         np.int32)
@@ -57,11 +99,23 @@ def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
     eos_ids = []
     if getattr(tokenizer, "eos_token_id", None) is not None:
         eos_ids.append(int(tokenizer.eos_token_id))
-    out = greedy_generate_cached(
-        params, jnp.asarray(buf), jnp.asarray([len(ids)], jnp.int32), cfg,
-        max_new_tokens=max_new_tokens, eos_ids=tuple(eos_ids),
-        lora=lora, lora_scale=lora_scale)
-    out = np.asarray(out[0])
+    if mesh is not None:
+        gen_fn = _replicated_generate(mesh, cfg, max_new_tokens,
+                                      tuple(eos_ids), lora_scale)
+        out = gen_fn(params, _place_replicated(mesh, buf),
+                     _place_replicated(
+                         mesh, np.asarray([len(ids)], np.int32)),
+                     lora)
+        # replicated sharding: any addressable shard IS the full array
+        # (np.asarray on the global array would require every device to
+        # be addressable, which fails under multi-process)
+        out = np.asarray(out.addressable_data(0)[0])
+    else:
+        out = greedy_generate_cached(
+            params, jnp.asarray(buf), jnp.asarray([len(ids)], jnp.int32),
+            cfg, max_new_tokens=max_new_tokens, eos_ids=tuple(eos_ids),
+            lora=lora, lora_scale=lora_scale)
+        out = np.asarray(out[0])
     gen = out[len(ids):]
     gen = gen[gen != 0]
     if eos_ids:
@@ -77,10 +131,17 @@ def run_inference_comparison(
         num_samples: int = 2, max_new_tokens: int = 300,
         output_path: Optional[str] = None,
         row_filter: Optional[Callable[[Dict], bool]] = None,
-        format_example: Callable = format_gretel_sql_example) -> List[Dict]:
+        format_example: Callable = format_gretel_sql_example,
+        mesh: Optional[Mesh] = None,
+        is_host0: bool = True) -> List[Dict]:
     """Returns the accumulated comparison records; writes JSON when
     ``output_path`` is given (reference behavior: filter on
-    sql_complexity == 'window functions', :87-96; JSON dump :182-187)."""
+    sql_complexity == 'window functions', :87-96; JSON dump :182-187).
+
+    COLLECTIVE once ``mesh`` is given and params are sharded: every host
+    must call this with identical ``test_rows`` (see module docstring);
+    ``is_host0`` gates only the log lines and the JSON write.
+    """
     if row_filter is not None:
         test_rows = [r for r in test_rows if row_filter(r)]
     test_rows = test_rows[:num_samples]
@@ -94,16 +155,17 @@ def run_inference_comparison(
             "reference_answer": msgs["assistant"],
             "base_model_answer": generate_answer(
                 base_params, cfg, tokenizer, prompt,
-                max_new_tokens=max_new_tokens),
+                max_new_tokens=max_new_tokens, mesh=mesh),
             "finetuned_model_answer": generate_answer(
                 tuned_params, cfg, tokenizer, prompt,
-                max_new_tokens=max_new_tokens),
+                max_new_tokens=max_new_tokens, mesh=mesh),
         }
-        logger.info("sample %d\n  Q: %s\n  base: %s\n  tuned: %s", i,
-                    record["question"], record["base_model_answer"],
-                    record["finetuned_model_answer"])
+        if is_host0:
+            logger.info("sample %d\n  Q: %s\n  base: %s\n  tuned: %s", i,
+                        record["question"], record["base_model_answer"],
+                        record["finetuned_model_answer"])
         results.append(record)
-    if output_path:
+    if output_path and is_host0:
         os.makedirs(os.path.dirname(output_path) or ".", exist_ok=True)
         with open(output_path, "w") as f:
             json.dump(results, f, indent=2)

@@ -1,0 +1,73 @@
+"""Sharded exact-eval walk (SURVEY.md §5.5; VERDICT r3 weak #5).
+
+The reference's eval is HF Trainer's (every rank evaluates its
+DistributedSampler shard, reference fine_tune_config.json:24-25); the
+round-2 TPU port instead had every host walk ALL eval rows — correct
+(the weighted mean is unchanged when each example is counted n_hosts
+times) but O(in_shards) wasted compute every eval. This module
+partitions the rows across input-shard groups (parallel/placement.py)
+while keeping the SPMD program in lockstep:
+
+- every shard group walks the SAME number of steps (the global row count
+  is padded up to steps * host_batch * in_shards);
+- padding rows carry zero weights, so they contribute nothing to the
+  token-weighted sums;
+- the jitted eval step reduces over the *global* placed batch, so the
+  distinct per-shard rows combine into exact global (nll, weight) sums —
+  identical eval_loss to the all-rows walk, 1/in_shards the work.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+
+def sharded_eval_sums(state, eval_step: Callable,
+                      rows: Dict[str, np.ndarray], *,
+                      host_batch: int, in_shards: int = 1,
+                      in_shard_id: int = 0,
+                      place_batch: Callable = None) -> tuple:
+    """Walk this shard group's partition of ``rows`` and return the
+    global (nll_sum, weight_sum) floats.
+
+    COLLECTIVE under multi-host: every host must call this with the same
+    ``rows`` (the partition is computed locally from in_shard_id) and
+    the same shapes; ``eval_step`` must reduce over the global batch
+    (train.step.make_eval_step does).
+    """
+    eb = max(host_batch, 1)
+    n_rows = len(rows["inputs"])
+    stride = eb * in_shards
+    steps = max((n_rows + stride - 1) // stride, 1)
+    nll = w = 0.0
+    for s in range(steps):
+        start = s * stride + in_shard_id * eb
+        b = {k: v[start:start + eb] for k, v in rows.items()}
+        got = len(b["inputs"])
+        if got < eb:
+            # zero-weight padding keeps the placed global shape constant
+            # (one compiled eval step) and every shard in lockstep even
+            # when only some shards have tail rows
+            b = {k: np.concatenate(
+                [v, np.zeros((eb - got,) + v.shape[1:], v.dtype)])
+                for k, v in b.items()}
+        if place_batch is not None:
+            b = place_batch(b)
+        n, ww = eval_step(state, b)
+        nll += float(n)
+        w += float(ww)
+    return nll, w
+
+
+def sharded_eval_loss(state, eval_step: Callable,
+                      rows: Dict[str, np.ndarray], *,
+                      host_batch: int, in_shards: int = 1,
+                      in_shard_id: int = 0,
+                      place_batch: Callable = None) -> float:
+    nll, w = sharded_eval_sums(
+        state, eval_step, rows, host_batch=host_batch,
+        in_shards=in_shards, in_shard_id=in_shard_id,
+        place_batch=place_batch)
+    return nll / max(w, 1.0)

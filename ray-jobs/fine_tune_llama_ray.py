@@ -249,26 +249,17 @@ def train_loop_per_worker(config: dict):
             group_by_length=group_by_length)
 
     def eval_fn(st):
-        # every host walks the SAME eval rows (each example counted
-        # n_hosts times — the weighted mean is unchanged); partial tail
-        # batches are padded with zero-weight rows so the placed global
-        # shape stays constant (one compiled eval step)
-        nll = w = 0.0
-        rows = eval_rows
-        eb = max(host_batch, 1)
-        n_rows = len(rows["inputs"])
-        for s in range(max((n_rows + eb - 1) // eb, 1)):
-            b = {k: v[s * eb:(s + 1) * eb] for k, v in rows.items()}
-            got = len(b["inputs"])
-            if got == 0:
-                break
-            if got < eb:
-                b = {k: np.concatenate(
-                    [v, np.zeros((eb - got,) + v.shape[1:], v.dtype)])
-                    for k, v in b.items()}
-            n, ww = eval_fn_step(st, place(b))
-            nll += float(n); w += float(ww)
-        return {"eval_loss": nll / max(w, 1.0)}
+        # eval rows are PARTITIONED across input-shard groups (the
+        # reference gets the same from HF Trainer's DistributedSampler
+        # eval): each group walks 1/in_shards of the rows, the jitted
+        # step reduces over the global placed batch, zero-weight padding
+        # keeps every shard in lockstep — exact eval loss at 1/in_shards
+        # the per-host work (train/evaluate.py)
+        from gke_ray_train_tpu.train.evaluate import sharded_eval_loss
+        return {"eval_loss": sharded_eval_loss(
+            st, eval_fn_step, eval_rows, host_batch=host_batch,
+            in_shards=in_shards, in_shard_id=in_shard_id,
+            place_batch=place)}
 
     meter = ThroughputMeter(cfg, seq_len=max_seq,
                             n_devices=len(jax.devices()))
@@ -333,19 +324,29 @@ def train_loop_per_worker(config: dict):
             write_sidecar(cfg, final_dir + "_orbax")
 
     # ---- optional inference comparison (§3.4) ------------------------
-    if bool(config.get("INFERENCE", False)) and ctx.is_host0():
+    # COLLECTIVE: every host enters the comparison — the params are
+    # mesh-sharded global arrays, so a host-0-only generate would
+    # diverge the SPMD program (the reference's rank-0 gate at :381-395
+    # is only valid because DDP replicates weights). is_host0 gates
+    # printing and the JSON write inside run_inference_comparison; every
+    # host holds identical ds_test rows (seeded downsample/synthetic).
+    if bool(config.get("INFERENCE", False)):
         from gke_ray_train_tpu.inference import run_inference_comparison
         # NOTE: the pre-training `params` handle was donated into the train
         # step (buffer aliasing), so it must not be used here. In LoRA mode
         # the base weights sit unchanged in state.params; in full-FT mode
         # reload them (the reference reloads from the hub, :69-76).
+        # `have_local` (not a fresh os.path.exists) keeps the branch
+        # choice collective — it was agreed across hosts at load time.
         if use_lora:
             base_params = state.params
-        elif ckpt_dir and os.path.exists(str(ckpt_dir)):
+        elif have_local:
             base_params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
         else:
-            logger.warning("full-FT smoke without a pretrained checkpoint: "
-                           "comparing tuned model against itself")
+            if ctx.is_host0():
+                logger.warning(
+                    "full-FT smoke without a pretrained checkpoint: "
+                    "comparing tuned model against itself")
             base_params = merged
         run_inference_comparison(
             base_params, merged, cfg, tokenizer, ds_test,
@@ -354,7 +355,8 @@ def train_loop_per_worker(config: dict):
                 config.get("MAX_NEW_GENERATION_TOKENS_INFERENCE", 300)),
             output_path=os.path.join(out_base, "inference_comparison.json"),
             row_filter=(lambda r: r.get("sql_complexity")
-                        == "window functions"))
+                        == "window functions"),
+            mesh=mesh, is_host0=ctx.is_host0())
     return metrics
 
 

@@ -1,0 +1,83 @@
+"""Sharded exact-eval walk (train/evaluate.py; VERDICT r3 weak #5):
+partitioned rows must reproduce the all-rows eval loss exactly, at
+1/in_shards the per-shard steps."""
+
+import jax
+import numpy as np
+
+from gke_ray_train_tpu.models import init_params, tiny
+from gke_ray_train_tpu.train import make_eval_step, make_train_state
+from gke_ray_train_tpu.train.evaluate import (
+    sharded_eval_loss, sharded_eval_sums)
+from gke_ray_train_tpu.train.optim import (
+    make_optimizer, warmup_cosine_schedule)
+
+
+def _setup(n_rows=10, seq=16):
+    cfg = tiny(vocab_size=61, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(warmup_cosine_schedule(1e-3, 10))
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    rows = {
+        "inputs": rng.integers(1, 61, (n_rows, seq)).astype(np.int32),
+        "targets": rng.integers(1, 61, (n_rows, seq)).astype(np.int32),
+        "weights": (rng.random((n_rows, seq)) > 0.3).astype(np.float32),
+    }
+    return cfg, state, rows
+
+
+def test_two_shards_reproduce_full_walk_exactly():
+    cfg, state, rows = _setup(n_rows=10)
+    calls = {"n": 0}
+    base_step = make_eval_step(cfg)
+
+    def counting_step(st, b):
+        calls["n"] += 1
+        return base_step(st, b)
+
+    full = sharded_eval_loss(state, counting_step, rows, host_batch=2)
+    full_steps = calls["n"]
+    assert full_steps == 5  # ceil(10 / 2)
+
+    # simulate 2 input-shard groups: each walks its partition; their
+    # partial sums combine to the identical global loss
+    calls["n"] = 0
+    parts = [sharded_eval_sums(state, counting_step, rows, host_batch=2,
+                               in_shards=2, in_shard_id=i)
+             for i in range(2)]
+    nll = sum(p[0] for p in parts)
+    w = sum(p[1] for p in parts)
+    assert np.isclose(nll / w, full, rtol=1e-6)
+    # per-shard walk is half the steps (ceil(10/4) = 3 each)
+    assert calls["n"] == 6
+    assert calls["n"] // 2 < full_steps
+
+
+def test_tail_padding_contributes_nothing():
+    cfg, state, rows = _setup(n_rows=7)  # 7 % (2*2) != 0 -> padded tail
+    step = make_eval_step(cfg)
+    full = sharded_eval_loss(state, step, rows, host_batch=2)
+    parts = [sharded_eval_sums(state, step, rows, host_batch=2,
+                               in_shards=2, in_shard_id=i)
+             for i in range(2)]
+    # shard 1's final slice is empty -> all-zero batch, zero weight
+    assert np.isclose(sum(p[0] for p in parts) / sum(p[1] for p in parts),
+                      full, rtol=1e-6)
+    total_w = sum(p[1] for p in parts)
+    assert np.isclose(total_w, rows["weights"].sum(), rtol=1e-6)
+
+
+def test_sharded_eval_on_mesh(fsdp_mesh):
+    """The placed-global-batch path: eval over the 2x4 mesh equals the
+    unsharded loss."""
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    cfg, state, rows = _setup(n_rows=8)
+    plain = sharded_eval_loss(state, make_eval_step(cfg), rows,
+                              host_batch=2)
+    place = make_place_batch(fsdp_mesh)
+    mesh_loss = sharded_eval_loss(
+        state, make_eval_step(cfg, mesh=fsdp_mesh), rows,
+        host_batch=8, place_batch=place)
+    assert np.isclose(mesh_loss, plain, rtol=1e-5)

