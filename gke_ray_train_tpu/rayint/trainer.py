@@ -73,6 +73,13 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = dataclasses.field(
         default_factory=FailureConfig)
+    # Hang detection (SURVEY.md §5.3): with no bound, one wedged worker
+    # (deadlocked collective, dead TPU host) blocks ray.get forever and
+    # FailureConfig never gets its chance. When set, an attempt that
+    # exceeds this wall-clock kills every worker and counts as a
+    # failure, so retry-with-resume proceeds. None = wait forever (the
+    # default: legitimate training runs have no universal time bound).
+    worker_timeout_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -163,9 +170,14 @@ class JaxTrainer:
                                scheduling_strategy=sched(i)).remote()
                 for i in range(n)]
             coord_ip = ray.get(workers[0].node_ip.remote())
-            try:
-                coord_port = int(ray.get(workers[0].free_port.remote()))
-            except Exception:  # noqa: BLE001
+            coord_port = None
+            for _ in range(3):   # transient RPC/bind failures retry
+                try:
+                    coord_port = int(ray.get(workers[0].free_port.remote()))
+                    break
+                except Exception:  # noqa: BLE001
+                    continue
+            if coord_port is None:
                 coord_port = DEFAULT_COORDINATOR_PORT
             env_base = {
                 "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
@@ -175,6 +187,29 @@ class JaxTrainer:
                 w.run.remote(self.fn, self.config,
                              {**env_base, "PROCESS_ID": str(i)})
                 for i, w in enumerate(workers)]
+            timeout = self.run_config.worker_timeout_s
+            if timeout is not None:
+                # hang detection: a worker stuck in a dead collective
+                # never returns, so ray.get alone would block fit()
+                # forever and FailureConfig.max_failures would never
+                # trigger. Bound the attempt, surface WHICH workers
+                # stalled, kill everything, and raise into the retry
+                # loop (workers resume from the latest checkpoint).
+                done, pending = ray.wait(futures,
+                                         num_returns=len(futures),
+                                         timeout=timeout)
+                if pending:
+                    stalled = sorted(i for i, f in enumerate(futures)
+                                     if f in pending)
+                    for w in workers:
+                        try:
+                            ray.kill(w)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    raise TimeoutError(
+                        f"worker(s) {stalled} still running after "
+                        f"{timeout}s (others done: {len(done)}/{n}); "
+                        "killed all workers for retry-with-resume")
             results = ray.get(futures)
         finally:
             # PGs outlive their Python handles; without removal a retry
