@@ -32,6 +32,10 @@ WEIGHT_PATTERNS = [
     "generation_config.json",
     "tokenizer*",
     "special_tokens_map.json",
+    # newer HF repos ship the chat template as its own file
+    # (chat_template.jinja / chat_template.json); without it the
+    # inference comparison renders a silently different prompt format
+    "chat_template*",
 ]
 
 

@@ -111,7 +111,9 @@ def sft_epoch_batches(rows: Dict[str, np.ndarray], global_batch: int, *,
         batches = by_len[:nb * global_batch].reshape(nb, global_batch)
         if shuffle:
             np.random.default_rng(seed + epoch).shuffle(batches, axis=0)
-        order = batches.reshape(-1)
+        # the tail joins at the end so no example is ever dropped
+        order = np.concatenate([batches.reshape(-1),
+                                by_len[nb * global_batch:]])
     else:
         order = np.arange(n)
         if shuffle:
@@ -122,6 +124,22 @@ def sft_epoch_batches(rows: Dict[str, np.ndarray], global_batch: int, *,
         chunk = order[s * global_batch:(s + 1) * global_batch]
         mine = chunk[host_id::num_hosts][:host_batch]
         yield {k: v[mine] for k, v in rows.items()}
+    # tail: the last n % global_batch examples train too (HF Trainer's
+    # dataloader keeps the final incomplete batch by default, and so did
+    # the reference; both paths here used to silently drop it — ADVICE
+    # r3 #2). The batch is padded to full host_batch with zero-weight
+    # rows so the placed global shape stays constant (one compiled step)
+    # and every host yields in lockstep.
+    rem = order[steps * global_batch:]
+    if len(rem):
+        mine = rem[host_id::num_hosts][:host_batch]
+        batch = {k: v[mine] for k, v in rows.items()}
+        pad = host_batch - len(mine)
+        if pad:
+            batch = {k: np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                for k, v in batch.items()}
+        yield batch
 
 
 def synthetic_sql_rows(n: int, seed: int = 0) -> List[Dict]:

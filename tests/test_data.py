@@ -149,3 +149,53 @@ def test_prepare_synthetic_idempotent(tmp_path):
     mtimes = {k: os.path.getmtime(v) for k, v in out.items()}
     out2 = prepare_wikitext2(str(tmp_path), synthetic_fallback=True)
     assert {k: os.path.getmtime(v) for k, v in out2.items()} == mtimes
+
+
+def test_sft_epoch_batches_keeps_tail_both_paths():
+    """No example is dropped (ADVICE r3 #2: BOTH the grouped and the
+    plain path used to truncate to full batches): the tail yields as a
+    final zero-weight-padded batch of the same shape."""
+    import numpy as np
+    from gke_ray_train_tpu.data.sft import sft_epoch_batches
+
+    n, gb = 10, 4
+    rows = {
+        "inputs": np.arange(n * 3, dtype=np.int32).reshape(n, 3) + 1,
+        "targets": np.arange(n * 3, dtype=np.int32).reshape(n, 3),
+        "weights": np.ones((n, 3), np.float32),
+    }
+    for grouped in (False, True):
+        batches = list(sft_epoch_batches(rows, gb,
+                                         group_by_length=grouped))
+        assert len(batches) == 3  # 2 full + 1 padded tail
+        assert all(b["inputs"].shape == (gb, 3) for b in batches)
+        seen = np.concatenate([b["inputs"][:, 0] for b in batches])
+        real = seen[seen != 0]
+        # every example appears exactly once; padding rows weigh zero
+        assert sorted(real.tolist()) == sorted(
+            rows["inputs"][:, 0].tolist()), grouped
+        tail = batches[-1]
+        assert tail["weights"][-2:].sum() == 0  # 2 pad rows
+        assert tail["weights"][:2].sum() > 0
+
+
+def test_sft_epoch_batches_tail_sharded_lockstep():
+    """Every host yields the same number of batches even when the tail
+    rows do not cover every shard."""
+    import numpy as np
+    from gke_ray_train_tpu.data.sft import sft_epoch_batches
+
+    n, gb, hosts = 9, 4, 2
+    rows = {"inputs": np.ones((n, 3), np.int32),
+            "weights": np.ones((n, 3), np.float32),
+            "targets": np.ones((n, 3), np.int32)}
+    per_host = [list(sft_epoch_batches(rows, gb, num_hosts=hosts,
+                                       host_id=h, shuffle=False))
+                for h in range(hosts)]
+    assert len(per_host[0]) == len(per_host[1]) == 3
+    assert all(b["inputs"].shape == (gb // hosts, 3)
+               for bs in per_host for b in bs)
+    # 9 = 2 full global batches (8) + 1 tail row on host 0, pad elsewhere
+    total_w = sum(float(b["weights"].sum()) for bs in per_host
+                  for b in bs)
+    assert total_w == n * 3

@@ -109,3 +109,13 @@ def test_load_hf_checkpoint_quantize_on_load_sharded(hf_export):
     assert is_qtensor(blk["wq"])
     # codes land sharded across the mesh
     assert len(blk["wq"].codes.sharding.device_set) == 4
+
+
+def test_weight_patterns_cover_chat_template():
+    """Newer HF repos ship chat_template.jinja/json separately; missing
+    it silently changes prompt rendering (ADVICE r2, unfixed until r4)."""
+    import fnmatch
+    from gke_ray_train_tpu.ckpt.hub import WEIGHT_PATTERNS
+    for fname in ("chat_template.jinja", "chat_template.json"):
+        assert any(fnmatch.fnmatch(fname, p) for p in WEIGHT_PATTERNS), \
+            fname
