@@ -15,8 +15,12 @@ where both agree, the discrepancy field is ~0 and either is valid.
 
 Extra modes via BENCH_MODE env (recorded in BASELINE.md, not by the
 driver): ``qlora8b`` (full Llama-3.1-8B dims, NF4 frozen base + r=64
-LoRA on one chip), ``seq4k`` (packed 4k-sequence training, BASELINE
-config 5), ``decode`` (KV-cache greedy decode tokens/sec).
+LoRA on one chip), ``mistral7b-lora`` (BASELINE config 4: full
+Mistral-7B dims, sliding-window attention, NF4 base + LoRA),
+``gemma2-4k`` (BASELINE config 5 shape: Gemma-2 pattern — alternating
+sliding/global, softcaps, tied embeddings — packed seq 4096),
+``seq4k`` (packed 4k llama-proxy), ``decode`` (KV-cache greedy decode
+tokens/sec).
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -121,27 +125,9 @@ def bench_train():
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
     step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
 
-    batch = {
-        "inputs": jax.random.randint(jax.random.key(1), (B, S), 0,
-                                     cfg.vocab_size),
-        "targets": jax.random.randint(jax.random.key(2), (B, S), 0,
-                                      cfg.vocab_size),
-        "weights": jnp.ones((B, S), jnp.float32),
-    }
-    batch = jax.device_put(batch, batch_shardings(mesh))
-
-    state, m = step(state, batch)  # compile
-    float(jax.device_get(m["loss"]))
-    latency = _measure_latency()
-
-    holder = {"state": state, "m": m}
-
-    def run_steps(n):
-        for _ in range(n):
-            holder["state"], holder["m"] = step(holder["state"], batch)
-        return holder["m"]["loss"]
-
-    dt_get, dt_block = _timed_loop(run_steps, steps, latency)
+    batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
+                           batch_shardings(mesh))
+    dt_get, dt_block, loss = _run_timed_train(step, state, batch, steps)
     tokens = B * S * steps
     tps_chip = tokens / dt_get / n_dev
     mfu = (tokens / dt_get) * train_flops_per_token(cfg, S) / (
@@ -151,82 +137,14 @@ def bench_train():
         f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, bf16, "
         f"{devices[0].device_kind} x{n_dev})",
         tps_chip, "tokens/sec/chip",
-        {"mfu": round(mfu, 4),
-         "loss": round(float(jax.device_get(holder['m']['loss'])), 4),
+        {"mfu": round(mfu, 4), "loss": round(loss, 4),
          "timing": {"device_get_s": round(dt_get, 4),
                     "block_until_ready_s": round(dt_block, 4)}})
 
 
-def _quantized_llama8b_params(cfg, kind="nf4"):
-    """Build the quantized frozen base DIRECTLY (per-repeat slices) —
-    materializing 8B fp32/bf16 params first would blow the 16 GB chip."""
-    from gke_ray_train_tpu.models import init_params
-    from gke_ray_train_tpu.ops.quant import (
-        QTensor, QUANT_TARGETS, quantize_tensor)
-
-    shapes = jax.eval_shape(lambda k: init_params(cfg, k),
-                            jax.random.key(0))
-    key = jax.random.key(0)
-    counter = [0]
-
-    def leaf(path, sd):
-        counter[0] += 1
-        k = jax.random.fold_in(key, counter[0])
-        name = next((p.key for p in reversed(path)
-                     if hasattr(p, "key")), "")
-        if name in QUANT_TARGETS and len(sd.shape) == 3:
-            parts = []
-            for r in range(sd.shape[0]):
-                w = jax.random.normal(jax.random.fold_in(k, r),
-                                      sd.shape[1:], jnp.bfloat16) * 0.02
-                parts.append(quantize_tensor(w[None], kind))
-            return QTensor(
-                jnp.concatenate([p.codes for p in parts]),
-                jnp.concatenate([p.scales for p in parts]),
-                parts[0].kind, parts[0].group)
-        return jax.random.normal(k, sd.shape, jnp.bfloat16) * 0.02
-
-    return jax.tree_util.tree_map_with_path(leaf, shapes)
-
-
-def bench_qlora8b():
-    """Flagship size on one chip: Llama-3.1-8B dims, NF4 frozen base,
-    r=64 LoRA adapters trained (the reference's exact QLoRA workload,
-    fine_tune_config.json)."""
-    import dataclasses
-
-    from gke_ray_train_tpu.models import llama3_8b
-    from gke_ray_train_tpu.train import (
-        LoraConfig, make_optimizer, make_train_step,
-        train_flops_per_token, warmup_cosine_schedule)
-    from gke_ray_train_tpu.train.lora import init_lora
-    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
-    from gke_ray_train_tpu.train.step import TrainState
-
-    devices = jax.devices()
-    n_dev = len(devices)
-    B, S, steps = 4, 1024, 10
-    cfg = dataclasses.replace(
-        llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=S,
-        dtype="bfloat16", param_dtype="bfloat16", remat=True)
-
-    params = _quantized_llama8b_params(cfg)
-    lcfg = LoraConfig(r=64, alpha=16)
-    lora = init_lora(cfg, lcfg, jax.random.key(1))
-    schedule = warmup_cosine_schedule(2e-4, 1000)
-    opt = make_optimizer(schedule)
-    opt_state = jax.jit(opt.init)(lora)
-    state = TrainState(params=params, lora=lora, opt_state=opt_state,
-                       step=jnp.zeros((), jnp.int32))
-    step = make_train_step(cfg, opt, lora_cfg=lcfg, schedule=schedule)
-
-    batch = {
-        "inputs": jax.random.randint(jax.random.key(2), (B, S), 0,
-                                     cfg.vocab_size),
-        "targets": jax.random.randint(jax.random.key(3), (B, S), 0,
-                                      cfg.vocab_size),
-        "weights": jnp.ones((B, S), jnp.float32),
-    }
+def _run_timed_train(step, state, batch, steps):
+    """Shared timing scaffold: compile once, then time `steps` chained
+    steps with both sync methods. Returns (dt_get, dt_block, last_loss)."""
     state, m = step(state, batch)
     float(jax.device_get(m["loss"]))
     latency = _measure_latency()
@@ -238,16 +156,154 @@ def bench_qlora8b():
         return holder["m"]["loss"]
 
     dt_get, dt_block = _timed_loop(run_steps, steps, latency)
+    return dt_get, dt_block, float(jax.device_get(holder["m"]["loss"]))
+
+
+def _rand_batch(B, S, vocab):
+    return {
+        "inputs": jax.random.randint(jax.random.key(2), (B, S), 0, vocab),
+        "targets": jax.random.randint(jax.random.key(3), (B, S), 0, vocab),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+
+
+def _bench_qlora_family(cfg, label, *, B, S, steps, lora_r=64):
+    """NF4 frozen base + LoRA adapters at full family dims on the
+    attached chip(s) — the measured shape for BASELINE configs that
+    fine-tune with PEFT (quantize-during-init keeps the bf16 tree from
+    ever materializing, models/qinit.py)."""
+    from gke_ray_train_tpu.models.qinit import init_quantized_params
+    from gke_ray_train_tpu.train import (
+        LoraConfig, make_optimizer, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.lora import init_lora
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+    from gke_ray_train_tpu.train.step import TrainState
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    params = init_quantized_params(cfg, jax.random.key(0))
+    lcfg = LoraConfig(r=lora_r, alpha=16)
+    lora = init_lora(cfg, lcfg, jax.random.key(1))
+    schedule = warmup_cosine_schedule(2e-4, 1000)
+    opt = make_optimizer(schedule)
+    opt_state = jax.jit(opt.init)(lora)
+    state = TrainState(params=params, lora=lora, opt_state=opt_state,
+                       step=jnp.zeros((), jnp.int32))
+    step = make_train_step(cfg, opt, lora_cfg=lcfg, schedule=schedule)
+
+    dt_get, dt_block, loss = _run_timed_train(
+        step, state, _rand_batch(B, S, cfg.vocab_size), steps)
     tokens = B * S * steps
     tps_chip = tokens / dt_get / n_dev
     mfu = (tokens / dt_get) * train_flops_per_token(
         cfg, S, trainable="lora") / (peak_flops_per_device() * n_dev)
     _emit(
-        f"tokens/sec/chip Llama-3.1-8B QLoRA (NF4 base, r=64) seq {S} "
+        f"tokens/sec/chip {label} (NF4 base, r={lora_r}) seq {S} "
         f"({devices[0].device_kind} x{n_dev})",
         tps_chip, "tokens/sec/chip",
-        {"mfu_lora_flops": round(mfu, 4),
-         "loss": round(float(jax.device_get(holder['m']['loss'])), 4),
+        {"mfu_lora_flops": round(mfu, 4), "loss": round(loss, 4),
+         "timing": {"device_get_s": round(dt_get, 4),
+                    "block_until_ready_s": round(dt_block, 4)}},
+        compare_baseline=False)
+
+
+def bench_qlora8b():
+    """Flagship size on one chip: Llama-3.1-8B dims, NF4 frozen base,
+    r=64 LoRA adapters trained (the reference's exact QLoRA workload,
+    fine_tune_config.json)."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import llama3_8b
+
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=1024,
+        dtype="bfloat16", param_dtype="bfloat16", remat=True)
+    _bench_qlora_family(cfg, "Llama-3.1-8B QLoRA", B=4, S=1024, steps=10)
+
+
+def bench_mistral7b_lora():
+    """BASELINE config 4: Mistral-7B dims (sliding-window attention
+    pattern) + LoRA adapters over an NF4 frozen base — the PEFT
+    fine-tune shape at full family size on one chip. CPU fallback runs
+    pattern-faithful tiny dims so the mode stays testable."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import mistral_7b
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = dataclasses.replace(
+            mistral_7b(), name="mistral7b-lora-bench", max_seq_len=1024,
+            dtype="bfloat16", param_dtype="bfloat16", remat=True)
+        B, S, steps = 4, 1024, 10
+    else:
+        cfg = dataclasses.replace(
+            mistral_7b(), name="mistral7b-lora-bench", d_model=256,
+            n_layers=2, n_heads=4, n_kv_heads=2, d_ff=512,
+            vocab_size=2048, max_seq_len=256, sliding_window=128,
+            dtype="bfloat16", param_dtype="bfloat16", remat=True)
+        B, S, steps = 2, 256, 2
+    _bench_qlora_family(cfg, "Mistral-7B LoRA", B=B, S=S, steps=steps)
+
+
+def bench_gemma2_4k():
+    """BASELINE config 5 shape: Gemma-2 architectural pattern
+    (sliding/global alternation, attn+logit softcaps, gelu, post-block
+    norms, tied embeddings) at seq 4096 PACKED (segment-ID masks), sized
+    to train full-FT on the attached chip(s)."""
+    import dataclasses
+    import numpy as np
+
+    from gke_ray_train_tpu.models import gemma2_9b
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        # ~0.9B proxy with every Gemma-2 mechanism live; full 9B needs
+        # the v5e-16 fsdp mesh, not one chip
+        size = dict(d_model=2048, n_layers=12, n_heads=8, n_kv_heads=4,
+                    d_ff=8192, vocab_size=32768, head_dim=256)
+        B, S, steps = 2, 4096, 10
+    else:
+        size = dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_ff=512, vocab_size=2048, head_dim=64)
+        B, S, steps = 2, 512, 2
+    cfg = dataclasses.replace(
+        gemma2_9b(), name="gemma2-4k-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        attn_scale=size["head_dim"] ** -0.5, **size)
+
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, schedule=schedule)
+
+    # packed rows: 4 documents per row, positions restart per segment
+    seg_len = S // 4
+    seg = np.repeat(np.arange(1, 5), seg_len)[None, :].repeat(B, 0)
+    pos = np.tile(np.arange(seg_len), 4)[None, :].repeat(B, 0)
+    batch = dict(_rand_batch(B, S, cfg.vocab_size),
+                 segment_ids=jnp.asarray(seg, jnp.int32),
+                 positions=jnp.asarray(pos, jnp.int32))
+
+    dt_get, dt_block, loss = _run_timed_train(step, state, batch, steps)
+    tokens = B * S * steps
+    tps_chip = tokens / dt_get / n_dev
+    # packed rows attend within segments only
+    mfu = (tokens / dt_get) * train_flops_per_token(cfg, seg_len) / (
+        peak_flops_per_device() * n_dev)
+    _emit(
+        f"tokens/sec/chip Gemma-2-pattern packed-seq{S} instruction-tune "
+        f"({cfg.d_model}d/{cfg.n_layers}L, {devices[0].device_kind} "
+        f"x{n_dev})",
+        tps_chip, "tokens/sec/chip",
+        {"mfu": round(mfu, 4), "loss": round(loss, 4),
          "timing": {"device_get_s": round(dt_get, 4),
                     "block_until_ready_s": round(dt_block, 4)}},
         compare_baseline=False)
@@ -286,26 +342,10 @@ def bench_seq4k():
     seg_len = S // 4
     seg = np.repeat(np.arange(1, 5), seg_len)[None, :].repeat(B, 0)
     pos = np.tile(np.arange(seg_len), 4)[None, :].repeat(B, 0)
-    batch = {
-        "inputs": jax.random.randint(jax.random.key(1), (B, S), 0,
-                                     cfg.vocab_size),
-        "targets": jax.random.randint(jax.random.key(2), (B, S), 0,
-                                      cfg.vocab_size),
-        "weights": jnp.ones((B, S), jnp.float32),
-        "segment_ids": jnp.asarray(seg, jnp.int32),
-        "positions": jnp.asarray(pos, jnp.int32),
-    }
-    state, m = step(state, batch)
-    float(jax.device_get(m["loss"]))
-    latency = _measure_latency()
-    holder = {"state": state, "m": m}
-
-    def run_steps(n):
-        for _ in range(n):
-            holder["state"], holder["m"] = step(holder["state"], batch)
-        return holder["m"]["loss"]
-
-    dt_get, dt_block = _timed_loop(run_steps, steps, latency)
+    batch = dict(_rand_batch(B, S, cfg.vocab_size),
+                 segment_ids=jnp.asarray(seg, jnp.int32),
+                 positions=jnp.asarray(pos, jnp.int32))
+    dt_get, dt_block, _loss = _run_timed_train(step, state, batch, steps)
     tokens = B * S * steps
     tps_chip = tokens / dt_get / n_dev
     # packed rows attend within segments only: attention FLOPs scale
@@ -364,6 +404,8 @@ def bench_decode():
 def main():
     mode = os.environ.get("BENCH_MODE", "train")
     {"train": bench_train, "qlora8b": bench_qlora8b,
+     "mistral7b-lora": bench_mistral7b_lora,
+     "gemma2-4k": bench_gemma2_4k,
      "seq4k": bench_seq4k, "decode": bench_decode}[mode]()
 
 

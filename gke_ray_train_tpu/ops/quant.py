@@ -49,16 +49,22 @@ _U4_PROBED = None
 def _nf4_store_dtype():
     """uint4 (2 codes/byte) where the backend supports it, else int8.
 
-    Probed once per process: some runtimes (e.g. the tunneled axon
-    backend in this dev environment) cannot create/transfer sub-byte
-    arrays even though jnp.uint4 exists."""
+    Probed once per process with the exact lifecycle QLoRA codes have —
+    created by one jit, then consumed as an argument by ANOTHER jit (the
+    train step): some runtimes (e.g. the tunneled axon backend in this
+    dev environment) create sub-byte arrays fine but blow up with a
+    RecursionError when a second executable re-lays them out at
+    dispatch, so a bare create/device_get probe passes and the first
+    real train step dies."""
     global _U4_PROBED
     if _U4_PROBED is None:
         if not hasattr(jnp, "uint4"):
             _U4_PROBED = jnp.int8
         else:
             try:
-                jax.device_get(jnp.zeros((8,), jnp.uint4))
+                x = jax.jit(lambda: jnp.zeros((8,), jnp.uint4))()
+                jax.device_get(x)
+                jax.device_get(jax.jit(lambda a: a.astype(jnp.int8))(x))
                 _U4_PROBED = jnp.uint4
             except Exception:  # noqa: BLE001 - any backend failure → int8
                 _U4_PROBED = jnp.int8

@@ -81,19 +81,26 @@ def opt_state_specs(optimizer: optax.GradientTransformation,
 
 def make_train_state(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                      key: jax.Array, *, mesh: Optional[Mesh] = None,
-                     lora_cfg: Optional[LoraConfig] = None) -> TrainState:
+                     lora_cfg: Optional[LoraConfig] = None,
+                     params: Optional[Params] = None) -> TrainState:
     """Initialize params (sharded at creation when a mesh is given — an 8B
     fp32 init must never materialize on one host) and optimizer state.
+
+    ``params``: pass pre-built weights (hub-loaded, quantized) to skip
+    the random init entirely — without this, a QLoRA caller substituting
+    its own base would still materialize the full fp32 tree here first
+    and OOM a single chip at 8B dims.
 
     Optimizer state shardings are *propagated* from param shardings by
     jitting optimizer.init — mu/nu inherit the fsdp sharding, scalars
     replicate. This is the ZeRO analogue (SURVEY.md row D5)."""
-    if mesh is not None:
-        p_shard = tree_shardings(mesh, param_specs(cfg))
-        params = jax.jit(lambda k: init_params(cfg, k),
-                         out_shardings=p_shard)(key)
-    else:
-        params = init_params(cfg, key)
+    if params is None:
+        if mesh is not None:
+            p_shard = tree_shardings(mesh, param_specs(cfg))
+            params = jax.jit(lambda k: init_params(cfg, k),
+                             out_shardings=p_shard)(key)
+        else:
+            params = init_params(cfg, key)
 
     lora = None
     if lora_cfg is not None:

@@ -59,7 +59,6 @@ def train_loop_per_worker(config: dict):
     from gke_ray_train_tpu.train.profiling import (
         apply_debug_flags, profiler_from_config)
     from gke_ray_train_tpu.train.tb import writer_from_config
-    from gke_ray_train_tpu.train.step import TrainState
 
     from gke_ray_train_tpu.config import (
         audit_config, cadence_from_config, optimizer_from_config,
@@ -144,9 +143,19 @@ def train_loop_per_worker(config: dict):
                 "no local checkpoint and hub unreachable; initializing "
                 "RANDOM weights (fine-tuning semantics require a "
                 "pretrained checkpoint)")
-        p_shard = tree_shardings(mesh, param_specs(cfg))
-        params = jax.jit(lambda k: init_params(cfg, k),
-                         out_shardings=p_shard)(jax.random.key(0))
+        if load_quant is not None:
+            # QLoRA random init quantizes DURING init (one repeat-slice
+            # at a time, models/qinit.py) — full-dim 8B never
+            # materializes fp32, so offline flagship-dims runs fit one
+            # 16 GB chip just like the stream-load path
+            from gke_ray_train_tpu.models.qinit import init_quantized_params
+            params = init_quantized_params(cfg, jax.random.key(0),
+                                           kind=load_quant, mesh=mesh)
+            already_quantized = True
+        else:
+            p_shard = tree_shardings(mesh, param_specs(cfg))
+            params = jax.jit(lambda k: init_params(cfg, k),
+                             out_shardings=p_shard)(jax.random.key(0))
 
     # ---- dataset ------------------------------------------------------
     n_train = int(config.get("NUM_TRAIN_SAMPLES", 1000))
@@ -200,7 +209,10 @@ def train_loop_per_worker(config: dict):
         train_rows = pad_sft_rows(train_exs, max_seq)
     eval_rows = pad_sft_rows(eval_exs, max_seq)
 
-    steps_per_epoch = max(len(train_rows["inputs"]) // global_batch, 1)
+    # ceil: the final partial batch trains too (sft_epoch_batches keeps
+    # the tail as a zero-weight-padded batch, HF drop_last=False parity)
+    steps_per_epoch = max(
+        -(-len(train_rows["inputs"]) // global_batch), 1)
     epochs = int(config.get("NUM_TRAIN_EPOCHS", 1))
     total_steps = steps_per_epoch * epochs
 
@@ -210,8 +222,6 @@ def train_loop_per_worker(config: dict):
     # fine_tune_config.json:15-17)
     schedule = schedule_from_config(config, total_steps)
     opt = optimizer_from_config(config, schedule)
-    state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
-                             lora_cfg=lora_cfg)
     # QLoRA = LoRA adapters over a *quantized* frozen base (the
     # reference's BitsAndBytesConfig 4-bit NF4 load,
     # fine_tune_llama_ray.py:216-227) — here a pytree transform
@@ -220,8 +230,10 @@ def train_loop_per_worker(config: dict):
         from gke_ray_train_tpu.ops.quant import quantize_params
         params = quantize_params(params, kind=quant_kind)
         logger.info("quantized frozen base weights to %s", quant_kind)
-    state = TrainState(params=params, lora=state.lora,
-                       opt_state=state.opt_state, step=state.step)
+    # hand the acquired weights in — make_train_state must NOT random-init
+    # its own full fp32 tree first (at 8B dims that alone OOMs one chip)
+    state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
+                             lora_cfg=lora_cfg, params=params)
 
     step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
                               grad_accum=grad_accum, schedule=schedule)
@@ -261,8 +273,11 @@ def train_loop_per_worker(config: dict):
             in_shards=in_shards, in_shard_id=in_shard_id,
             place_batch=place)}
 
+    # LoRA runs bill the 4N FLOP count (frozen base skips weight-grad
+    # matmuls) so the logged MFU is honest (train/metrics.py)
     meter = ThroughputMeter(cfg, seq_len=max_seq,
-                            n_devices=len(jax.devices()))
+                            n_devices=len(jax.devices()),
+                            trainable="lora" if use_lora else "full")
     # LoRA checkpoints persist only adapters + optimizer state: the
     # frozen (possibly NF4-quantized) base is rebuilt from the pretrained
     # weights on resume — smaller checkpoints, and sub-byte code arrays
@@ -314,11 +329,15 @@ def train_loop_per_worker(config: dict):
     elif n_hosts > 1:
         # multi-host export path: orbax save (collective) + model-config
         # sidecar, then `python -m gke_ray_train_tpu.ckpt.convert
-        # <dir>_orbax <dir>` offline (ckpt/convert.py)
-        from gke_ray_train_tpu.ckpt.convert import write_sidecar
+        # <dir>_orbax <dir>` offline (ckpt/convert.py). Block leaves are
+        # saved per-layer (unstack_for_export) so the converter can
+        # restore O(one layer) at a time at 70B scale.
+        from gke_ray_train_tpu.ckpt.convert import (
+            unstack_for_export, write_sidecar)
         export_mgr = CheckpointManager(final_dir + "_orbax", max_to_keep=1,
                                        score_attribute=None)
-        export_mgr.save(int(jax.device_get(state.step)), merged, force=True)
+        export_mgr.save(int(jax.device_get(state.step)),
+                        unstack_for_export(merged), force=True)
         export_mgr.wait()
         if ctx.is_host0():
             write_sidecar(cfg, final_dir + "_orbax")
