@@ -117,7 +117,10 @@ def bench_train():
         B, S, steps = max(4, n_dev), 256, 3
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-bench", max_seq_len=S,
-        dtype="bfloat16", param_dtype="float32", remat=True, **size)
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        # BENCH_REMAT=dots saves matmul outputs instead of recomputing
+        # the block (models/config.py remat_policy) — measured A/B knob
+        remat_policy=os.environ.get("BENCH_REMAT", "full"), **size)
 
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1), devices)
     schedule = warmup_cosine_schedule(3e-4, 1000)
@@ -218,7 +221,8 @@ def bench_qlora8b():
 
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=1024,
-        dtype="bfloat16", param_dtype="bfloat16", remat=True)
+        dtype="bfloat16", param_dtype="bfloat16", remat=True,
+        remat_policy=os.environ.get("BENCH_REMAT", "full"))
     _bench_qlora_family(cfg, "Llama-3.1-8B QLoRA", B=4, S=1024, steps=10)
 
 
@@ -235,14 +239,16 @@ def bench_mistral7b_lora():
     if on_tpu:
         cfg = dataclasses.replace(
             mistral_7b(), name="mistral7b-lora-bench", max_seq_len=1024,
-            dtype="bfloat16", param_dtype="bfloat16", remat=True)
+            dtype="bfloat16", param_dtype="bfloat16", remat=True,
+            remat_policy=os.environ.get("BENCH_REMAT", "full"))
         B, S, steps = 4, 1024, 10
     else:
         cfg = dataclasses.replace(
             mistral_7b(), name="mistral7b-lora-bench", d_model=256,
             n_layers=2, n_heads=4, n_kv_heads=2, d_ff=512,
             vocab_size=2048, max_seq_len=256, sliding_window=128,
-            dtype="bfloat16", param_dtype="bfloat16", remat=True)
+            dtype="bfloat16", param_dtype="bfloat16", remat=True,
+            remat_policy=os.environ.get("BENCH_REMAT", "full"))
         B, S, steps = 2, 256, 2
     _bench_qlora_family(cfg, "Mistral-7B LoRA", B=B, S=S, steps=steps)
 
@@ -277,6 +283,7 @@ def bench_gemma2_4k():
     cfg = dataclasses.replace(
         gemma2_9b(), name="gemma2-4k-bench", max_seq_len=S,
         dtype="bfloat16", param_dtype="float32", remat=True,
+        remat_policy=os.environ.get("BENCH_REMAT", "full"),
         attn_scale=size["head_dim"] ** -0.5, **size)
 
     schedule = warmup_cosine_schedule(3e-4, 1000)
@@ -331,7 +338,8 @@ def bench_seq4k():
                  d_ff=512, vocab_size=2048))
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-seq4k-bench", max_seq_len=S,
-        dtype="bfloat16", param_dtype="float32", remat=True, **size)
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        remat_policy=os.environ.get("BENCH_REMAT", "full"), **size)
 
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)

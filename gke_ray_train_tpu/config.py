@@ -50,8 +50,8 @@ KNOWN_KEYS = frozenset({
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",
     # TPU / mesh extensions
-    "TRAIN_DTYPE", "ATTN_IMPL", "MESH_DATA", "MESH_FSDP", "MESH_MODEL",
-    "MESH_CONTEXT", "NUM_SLICES", "SMOKE_TEST",
+    "TRAIN_DTYPE", "ATTN_IMPL", "REMAT_POLICY", "MESH_DATA", "MESH_FSDP",
+    "MESH_MODEL", "MESH_CONTEXT", "NUM_SLICES", "SMOKE_TEST",
     # profiling / debug (train/profiling.py)
     "PROFILE", "PROFILE_START_STEP", "PROFILE_NUM_STEPS", "DEBUG_NANS",
 })

@@ -53,6 +53,12 @@ class ModelConfig:
     dtype: str = "bfloat16"                 # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = True                      # checkpoint each block
+    # what the per-block checkpoint saves: "full" recomputes the whole
+    # block in backward (lowest memory, +2N recompute FLOPs/token);
+    # "dots" saves matmul outputs and recomputes only elementwise ops
+    # (more memory, near-zero recompute) — worth ~1/3 higher arithmetic
+    # throughput when activations fit HBM
+    remat_policy: str = "full"              # "full" | "dots"
     attn_impl: str = "auto"                 # "auto" | "xla" | "flash" | "ring"
     # "auto" resolves at trace time: flash (Pallas) on TPU, xla oracle off-TPU
 
@@ -80,6 +86,8 @@ class ModelConfig:
                              "run full global attention")
         if self.attn_impl not in ("auto", "xla", "flash", "ring"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
 
     def to_dict(self) -> dict:
         """JSON-serializable form (offline converter sidecar files)."""

@@ -339,7 +339,13 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
 
     body = repeat_body
     if cfg.remat:
-        body = jax.checkpoint(repeat_body, prevent_cse=False)
+        policy = None
+        if cfg.remat_policy == "dots":
+            # save matmul outputs, recompute only elementwise — trades
+            # HBM for the ~2N/token recompute the "full" policy pays
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        body = jax.checkpoint(repeat_body, prevent_cse=False,
+                              policy=policy)
     xs = [params["blocks"]]
     if lora is not None:
         xs.append(lora["blocks"])

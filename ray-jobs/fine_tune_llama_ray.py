@@ -96,7 +96,8 @@ def train_loop_per_worker(config: dict):
         cfg = preset_for_model_id(
             model_id,
             dtype=config.get("TRAIN_DTYPE", "bfloat16"),
-            attn_impl=config.get("ATTN_IMPL", "auto"))
+            attn_impl=config.get("ATTN_IMPL", "auto"),
+            remat_policy=config.get("REMAT_POLICY", "full"))
 
     # ---- weights ------------------------------------------------------
     # resolution order (reference: from_pretrained(MODEL_ID),
@@ -403,7 +404,11 @@ if __name__ == "__main__":
             name="llama-sft-tpu",
             storage_path=config.get("OUTPUT_DIR_BASE"),
             failure_config=FailureConfig(
-                max_failures=int(os.environ.get("MAX_FAILURES", "0")))),
+                max_failures=int(os.environ.get("MAX_FAILURES", "0"))),
+            # hang detection (rayint/trainer.py): unset = wait forever
+            worker_timeout_s=(float(os.environ["WORKER_TIMEOUT_S"])
+                              if "WORKER_TIMEOUT_S" in os.environ
+                              else None)),
     )
     result = trainer.fit()
     if result.error:

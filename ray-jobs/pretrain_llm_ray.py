@@ -85,6 +85,7 @@ def train_loop_per_worker(config: dict):
         d_ff=int(config.get("d_ff", 8192)),
         max_seq_len=max(seq_len, int(config.get("model_max_seq_len", 1024))),
         dtype=config.get("dtype", "bfloat16"),
+        remat_policy=config.get("remat_policy", "full"),
     )
 
     global_batch = int(config.get("batch_size_per_device", 16)) \
@@ -175,7 +176,11 @@ if __name__ == "__main__":
             name="basic-lm-pretrain",
             storage_path=train_loop_config["storage_path"],
             failure_config=FailureConfig(
-                max_failures=int(os.environ.get("MAX_FAILURES", "0")))),
+                max_failures=int(os.environ.get("MAX_FAILURES", "0"))),
+            # hang detection (rayint/trainer.py): unset = wait forever
+            worker_timeout_s=(float(os.environ["WORKER_TIMEOUT_S"])
+                              if "WORKER_TIMEOUT_S" in os.environ
+                              else None)),
     )
     result = trainer.fit()
     if result.error:
