@@ -38,6 +38,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import jax
 import jax.numpy as jnp
 
+# A/B knob for every remat-enabled mode: "full" (recompute the block in
+# backward, lowest memory) vs "dots" (save matmul outputs). Validated
+# here so a typo fails before an expensive TPU run, not silently.
+BENCH_REMAT_POLICY = os.environ.get("BENCH_REMAT", "full")
+if BENCH_REMAT_POLICY not in ("full", "dots"):
+    raise SystemExit(f"BENCH_REMAT={BENCH_REMAT_POLICY!r}; use full|dots")
+
 
 def _measure_latency() -> float:
     probe = jax.jit(lambda x: x + 1)
@@ -118,9 +125,7 @@ def bench_train():
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-bench", max_seq_len=S,
         dtype="bfloat16", param_dtype="float32", remat=True,
-        # BENCH_REMAT=dots saves matmul outputs instead of recomputing
-        # the block (models/config.py remat_policy) — measured A/B knob
-        remat_policy=os.environ.get("BENCH_REMAT", "full"), **size)
+        remat_policy=BENCH_REMAT_POLICY, **size)
 
     mesh = build_mesh(MeshConfig(data=1, fsdp=-1), devices)
     schedule = warmup_cosine_schedule(3e-4, 1000)
@@ -222,7 +227,7 @@ def bench_qlora8b():
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=1024,
         dtype="bfloat16", param_dtype="bfloat16", remat=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "full"))
+        remat_policy=BENCH_REMAT_POLICY)
     _bench_qlora_family(cfg, "Llama-3.1-8B QLoRA", B=4, S=1024, steps=10)
 
 
@@ -240,7 +245,7 @@ def bench_mistral7b_lora():
         cfg = dataclasses.replace(
             mistral_7b(), name="mistral7b-lora-bench", max_seq_len=1024,
             dtype="bfloat16", param_dtype="bfloat16", remat=True,
-            remat_policy=os.environ.get("BENCH_REMAT", "full"))
+            remat_policy=BENCH_REMAT_POLICY)
         B, S, steps = 4, 1024, 10
     else:
         cfg = dataclasses.replace(
@@ -248,7 +253,7 @@ def bench_mistral7b_lora():
             n_layers=2, n_heads=4, n_kv_heads=2, d_ff=512,
             vocab_size=2048, max_seq_len=256, sliding_window=128,
             dtype="bfloat16", param_dtype="bfloat16", remat=True,
-            remat_policy=os.environ.get("BENCH_REMAT", "full"))
+            remat_policy=BENCH_REMAT_POLICY)
         B, S, steps = 2, 256, 2
     _bench_qlora_family(cfg, "Mistral-7B LoRA", B=B, S=S, steps=steps)
 
@@ -283,7 +288,7 @@ def bench_gemma2_4k():
     cfg = dataclasses.replace(
         gemma2_9b(), name="gemma2-4k-bench", max_seq_len=S,
         dtype="bfloat16", param_dtype="float32", remat=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "full"),
+        remat_policy=BENCH_REMAT_POLICY,
         attn_scale=size["head_dim"] ** -0.5, **size)
 
     schedule = warmup_cosine_schedule(3e-4, 1000)
@@ -339,7 +344,7 @@ def bench_seq4k():
     cfg = dataclasses.replace(
         llama3_8b(), name="llama3-seq4k-bench", max_seq_len=S,
         dtype="bfloat16", param_dtype="float32", remat=True,
-        remat_policy=os.environ.get("BENCH_REMAT", "full"), **size)
+        remat_policy=BENCH_REMAT_POLICY, **size)
 
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)

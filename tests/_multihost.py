@@ -1,0 +1,80 @@
+"""Shared two-process jax.distributed harness for entry-script tests.
+
+Spawns N real processes (CPU backend, 4 fake devices each) running an
+entry module's ``train_loop_per_worker`` with a shared JSON config, and
+asserts every worker exits cleanly. A hang is the expected failure mode
+of multi-host bugs, so workers run under a wall-clock timeout.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER_CODE = """
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "entry_under_test", os.path.join({repo!r}, "ray-jobs", {script!r}))
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+config = json.loads(os.environ["MULTIHOST_SMOKE_CONFIG"])
+metrics = mod.train_loop_per_worker(config)
+assert metrics and "loss" in metrics, metrics
+print("WORKER_OK", jax.process_index(), flush=True)
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_entry_multiprocess(script: str, config: dict, *,
+                           num_processes: int = 2,
+                           devices_per_process: int = 4,
+                           timeout: float = 900) -> list:
+    """Run ray-jobs/<script>'s worker fn across real processes; returns
+    the per-rank stdout. Raises AssertionError with the failing rank's
+    tail on any non-zero exit."""
+    port = free_port()
+    procs = []
+    for rank in range(num_processes):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "HF_HUB_OFFLINE": "1",   # fail fast to offline fallbacks
+            "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                         f"{devices_per_process}",
+            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NUM_PROCESSES": str(num_processes),
+            "PROCESS_ID": str(rank),
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "MULTIHOST_SMOKE_CONFIG": json.dumps(config),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c",
+             _WORKER_CODE.format(repo=REPO, script=script)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, (
+            f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
+        assert f"WORKER_OK {rank}" in out
+    return outs

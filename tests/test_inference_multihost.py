@@ -18,9 +18,6 @@ IO (gke_ray_train_tpu/inference.py). Two layers of coverage:
 
 import json
 import os
-import socket
-import subprocess
-import sys
 
 import jax
 import numpy as np
@@ -30,8 +27,7 @@ from gke_ray_train_tpu.data import ByteTokenizer, synthetic_sql_rows
 from gke_ray_train_tpu.models import init_params, param_specs, tiny
 from gke_ray_train_tpu.parallel.sharding import tree_shardings
 from gke_ray_train_tpu.inference import run_inference_comparison
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from tests._multihost import run_entry_multiprocess
 
 
 def _tiny_setup():
@@ -67,34 +63,12 @@ def test_sharded_comparison_matches_unsharded(tp_mesh, tmp_path):
     assert json.loads((tmp_path / "plain.json").read_text())
 
 
-_WORKER_CODE = """
-import json, os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, {repo!r})
-import importlib.util
-spec = importlib.util.spec_from_file_location(
-    "fine_tune_entry", os.path.join({repo!r}, "ray-jobs",
-                                    "fine_tune_llama_ray.py"))
-mod = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(mod)
-config = json.loads(os.environ["FT_SMOKE_CONFIG"])
-metrics = mod.train_loop_per_worker(config)
-assert metrics and "loss" in metrics, metrics
-print("WORKER_OK", jax.process_index(), flush=True)
-"""
-
-
 @pytest.mark.slow
 def test_inference_branch_two_processes(tmp_path):
     """train_loop_per_worker INFERENCE branch under real multi-process
     SPMD: 2 jax.distributed processes x 4 fake CPU devices, mesh
     data=2 x fsdp=4 (the data axis spans the processes -> 2 input
     shards), QLoRA on, collective final export + collective inference."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
     out_base = str(tmp_path / "run")
     config = {
         "SMOKE_TEST": True,
@@ -120,36 +94,7 @@ def test_inference_branch_two_processes(tmp_path):
         "NUM_EVAL_SAMPLES_INFERENCE": 1,
         "MAX_NEW_GENERATION_TOKENS_INFERENCE": 8,
     }
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update({
-            "JAX_PLATFORMS": "cpu",
-            "HF_HUB_OFFLINE": "1",   # fail fast to the synthetic rows
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "NUM_PROCESSES": "2",
-            "PROCESS_ID": str(rank),
-            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-            "FT_SMOKE_CONFIG": json.dumps(config),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER_CODE.format(repo=REPO)],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=900)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, (
-            f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
-        assert f"WORKER_OK {rank}" in out
+    run_entry_multiprocess("fine_tune_llama_ray.py", config)
 
     # host 0 alone wrote the comparison; the collective generate ran on
     # both (ByteTokenizer decode of >=1 sample for base AND tuned)

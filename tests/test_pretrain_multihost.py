@@ -5,44 +5,18 @@ fake devices per process) the paths single-process tests cannot reach:
 the host-0 data prep + sync_global_devices barrier that replaced the
 reference's filesystem-flag race (SURVEY.md §5.2), ShardedBatches input
 partitioning with 2 input shards, the collective orbax checkpoint save
-over params sharded across processes, and the keep-best retention. A
-hang is the failure mode, so the workers run under a timeout.
+over params sharded across processes, and the keep-best retention.
 """
 
-import json
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-_WORKER_CODE = """
-import json, os, sys
-import jax
-jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, {repo!r})
-import importlib.util
-spec = importlib.util.spec_from_file_location(
-    "pretrain_entry", os.path.join({repo!r}, "ray-jobs",
-                                   "pretrain_llm_ray.py"))
-mod = importlib.util.module_from_spec(spec)
-spec.loader.exec_module(mod)
-config = json.loads(os.environ["PRETRAIN_SMOKE_CONFIG"])
-metrics = mod.train_loop_per_worker(config)
-assert metrics and "loss" in metrics, metrics
-print("WORKER_OK", jax.process_index(), flush=True)
-"""
+from tests._multihost import run_entry_multiprocess
 
 
 @pytest.mark.slow
 def test_pretrain_two_processes(tmp_path):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-
     config = {
         "d_model": 64, "n_layers": 2, "n_heads": 4, "d_ff": 128,
         "dataset_seq_len": 64, "model_max_seq_len": 128,
@@ -54,36 +28,7 @@ def test_pretrain_two_processes(tmp_path):
         "run_name": "smoke",
         "MESH_DATA": 2, "MESH_FSDP": -1,
     }
-    procs = []
-    for rank in range(2):
-        env = dict(os.environ)
-        env.update({
-            "JAX_PLATFORMS": "cpu",
-            "HF_HUB_OFFLINE": "1",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
-            "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
-            "NUM_PROCESSES": "2",
-            "PROCESS_ID": str(rank),
-            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-            "PRETRAIN_SMOKE_CONFIG": json.dumps(config),
-        })
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WORKER_CODE.format(repo=REPO)],
-            env=env, cwd=REPO, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=900)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, (
-            f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
-        assert f"WORKER_OK {rank}" in out
+    run_entry_multiprocess("pretrain_llm_ray.py", config)
 
     # host 0 prepped the data once; the collective checkpoint landed
     assert os.path.exists(tmp_path / "data" / "char_tokenizer.json")
