@@ -46,13 +46,18 @@ def token_nll(logits: jnp.ndarray, targets: jnp.ndarray,
               weights: jnp.ndarray):
     """Sum of weighted token NLL + sum of weights (exact-mean bookkeeping).
 
-    fp32 log-softmax regardless of compute dtype — same reduction the
+    fp32 math regardless of compute dtype — same reduction the
     reference gets from CrossEntropyLoss over flattened logits
-    (pytorch_llm_ray.py:233,275)."""
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    (pytorch_llm_ray.py:233,275). Formulated as logsumexp(logits) -
+    logits[target] rather than log_softmax + gather: identical values,
+    but the [B, S, V] log-probability array (1 GB at 8B's 128k vocab)
+    is never materialized — backward recomputes the softmax from the
+    logits it already holds."""
+    logits32 = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits32, axis=-1)
+    tgt = jnp.take_along_axis(logits32, targets[..., None], axis=-1)[..., 0]
     w = weights.astype(jnp.float32)
-    return jnp.sum(nll * w), jnp.sum(w)
+    return jnp.sum((lse - tgt) * w), jnp.sum(w)
 
 
 def opt_state_specs(optimizer: optax.GradientTransformation,

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Record the BASELINE.md measurement set on the attached TPU chip.
+# Each line of bench output is one JSON record; copy the numbers into
+# BASELINE.md with the exact command that produced them.
+#
+# Usage: bash scripts/record_baselines.sh [outfile]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-/tmp/baselines_$(date +%s).jsonl}"
+
+run() {
+  local label="$1"; shift
+  echo "== $label: $*" | tee -a "$OUT.log"
+  if timeout 1800 "$@" >> "$OUT" 2>> "$OUT.log"; then
+    tail -1 "$OUT"
+  else
+    echo "FAILED: $label (see $OUT.log)" | tee -a "$OUT"
+  fi
+}
+
+# driver-identical default (0.69B proxy, full remat) + the dots A/B
+run proxy-full  python bench.py
+run proxy-dots  env BENCH_REMAT=dots python bench.py
+
+# BASELINE.json configs at full family dims on one chip
+run qlora8b        env BENCH_MODE=qlora8b python bench.py
+run mistral7b-lora env BENCH_MODE=mistral7b-lora python bench.py
+run gemma2-4k      env BENCH_MODE=gemma2-4k python bench.py
+run seq4k          env BENCH_MODE=seq4k python bench.py
+run decode         env BENCH_MODE=decode python bench.py
+
+echo "records in $OUT"

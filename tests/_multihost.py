@@ -65,14 +65,24 @@ def run_entry_multiprocess(script: str, config: dict, *,
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
     outs = []
-    try:
-        for p in procs:
+    hung = []
+    for rank, p in enumerate(procs):
+        try:
             out, _ = p.communicate(timeout=timeout)
-            outs.append(out)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
+        except subprocess.TimeoutExpired:
+            # the hang IS the failure mode this harness exists to catch:
+            # kill, drain the pipe, and surface what the worker printed
+            hung.append(rank)
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    assert not hung, (
+        f"worker(s) {hung} hung past {timeout}s; outputs:\n" +
+        "\n---\n".join(o[-2000:] for o in outs))
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
