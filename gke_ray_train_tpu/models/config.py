@@ -59,7 +59,7 @@ class ModelConfig:
     # (more memory, near-zero recompute) — worth ~1/3 higher arithmetic
     # throughput when activations fit HBM
     remat_policy: str = "full"              # "full" | "dots"
-    attn_impl: str = "auto"                 # "auto" | "xla" | "flash" | "ring"
+    attn_impl: str = "auto"     # "auto" | "xla" | "flash" | "ring" | "a2a"
     # "auto" resolves at trace time: flash (Pallas) on TPU, xla oracle off-TPU
 
     def __post_init__(self):
@@ -84,7 +84,7 @@ class ModelConfig:
             raise ValueError("block_pattern contains 'sliding' but "
                              "sliding_window is None — that would silently "
                              "run full global attention")
-        if self.attn_impl not in ("auto", "xla", "flash", "ring"):
+        if self.attn_impl not in ("auto", "xla", "flash", "ring", "a2a"):
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")

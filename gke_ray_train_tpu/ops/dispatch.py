@@ -1,11 +1,12 @@
 """Attention implementation dispatch (cfg.attn_impl).
 
 "xla" is handled inline in the transformer (dense mask oracle); this
-module routes the accelerated paths — "flash" (Pallas kernel) and "ring"
-(context-parallel flash) — so the model code never imports kernels
-directly. Both take mask *inputs* (positions, segment ids, causality,
-window) rather than a materialized [S, T] mask: never building that mask
-in HBM is the point of the kernels.
+module routes the accelerated paths — "flash" (Pallas kernel), "ring"
+(context-parallel flash, K/V rotation) and "a2a" (Ulysses-style
+all-to-all context parallelism) — so the model code never imports
+kernels directly. All take mask *inputs* (positions, segment ids,
+causality, window) rather than a materialized [S, T] mask: never
+building that mask in HBM is the point of the kernels.
 
 Sharding: a ``pallas_call`` is a custom call GSPMD cannot partition, so
 under a mesh the flash kernel is wrapped in ``shard_map`` — each device
@@ -93,6 +94,33 @@ def attention_dispatch(impl: str, q, k, v, *,
                 "attn_impl='ring' requires ops/ring_attention.py, not yet "
                 "in this build") from e
         return ring_attention(
+            q, k, v, mesh=mesh, q_positions=q_positions,
+            kv_positions=kv_positions, q_segment_ids=q_segment_ids,
+            kv_segment_ids=kv_segment_ids, causal=causal,
+            sliding_window=sliding_window, scale=scale,
+            logit_softcap=logit_softcap, interpret=interpret)
+    if impl == "a2a":
+        from gke_ray_train_tpu.ops.a2a_attention import (
+            a2a_attention, a2a_supported)
+        if mesh is None or mesh.shape[AXIS_CONTEXT] == 1:
+            # no context sharding to redistribute — plain flash is the
+            # same computation
+            return _flash_sharded(
+                q, k, v, q_positions, kv_positions, q_segment_ids,
+                kv_segment_ids, mesh=mesh, causal=causal,
+                sliding_window=sliding_window, scale=scale,
+                logit_softcap=logit_softcap, interpret=interpret)
+        if not a2a_supported(mesh, q.shape[2], k.shape[2]):
+            # context axis does not divide the local head counts — ring
+            # computes the identical function without that constraint
+            return attention_dispatch(
+                "ring", q, k, v, q_positions=q_positions,
+                kv_positions=kv_positions, q_segment_ids=q_segment_ids,
+                kv_segment_ids=kv_segment_ids, causal=causal,
+                sliding_window=sliding_window, scale=scale,
+                logit_softcap=logit_softcap, mesh=mesh,
+                interpret=interpret)
+        return a2a_attention(
             q, k, v, mesh=mesh, q_positions=q_positions,
             kv_positions=kv_positions, q_segment_ids=q_segment_ids,
             kv_segment_ids=kv_segment_ids, causal=causal,

@@ -91,7 +91,8 @@ def train_loop_per_worker(config: dict):
     if smoke:
         cfg = tiny(vocab_size=max(getattr(tokenizer, "vocab_size", 260), 260),
                    max_seq_len=max_seq, dtype=config.get("TRAIN_DTYPE",
-                                                         "float32"))
+                                                         "float32"),
+                   attn_impl=config.get("ATTN_IMPL", "auto"))
     else:
         cfg = preset_for_model_id(
             model_id,

@@ -14,7 +14,8 @@ run() {
   if timeout 1800 "$@" >> "$OUT" 2>> "$OUT.log"; then
     tail -1 "$OUT"
   else
-    echo "FAILED: $label (see $OUT.log)" | tee -a "$OUT"
+    # keep $OUT pure JSONL — failures go to the log only
+    echo "FAILED: $label (see $OUT.log)" | tee -a "$OUT.log" >&2
   fi
 }
 

@@ -66,9 +66,14 @@ def run_entry_multiprocess(script: str, config: dict, *,
             stderr=subprocess.STDOUT, text=True))
     outs = []
     hung = []
+    import time
+    deadline = time.monotonic() + timeout
     for rank, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=timeout)
+            # one shared deadline: an all-workers deadlock must cost ~1x
+            # the timeout, not num_processes x
+            out, _ = p.communicate(
+                timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             # the hang IS the failure mode this harness exists to catch:
             # kill, drain the pipe, and surface what the worker printed
