@@ -41,7 +41,7 @@ def a2a_supported(mesh, n_heads: int, n_kv_heads: int) -> bool:
     C = mesh.shape[AXIS_CONTEXT]
     M = mesh.shape[AXIS_MODEL]
     h_loc, k_loc = n_heads // M, n_kv_heads // M
-    return C >= 1 and h_loc % C == 0 and k_loc % C == 0
+    return h_loc % C == 0 and k_loc % C == 0
 
 
 def a2a_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,

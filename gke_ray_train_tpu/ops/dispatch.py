@@ -11,9 +11,11 @@ building that mask in HBM is the point of the kernels.
 Sharding: a ``pallas_call`` is a custom call GSPMD cannot partition, so
 under a mesh the flash kernel is wrapped in ``shard_map`` — each device
 runs the kernel on its local (batch x head) shard. That is correct only
-while the sequence axis is unsharded; a context-sharded mesh must use
-"ring" (each device holds a sequence shard and K/V blocks rotate around
-the context axis).
+while the sequence axis is unsharded; a context-sharded mesh must use a
+sequence-parallel strategy — "ring" (K/V blocks rotate around the
+context axis) or "a2a" (all-to-all head/sequence redistribution, which
+falls back to ring when the context axis cannot divide the local head
+counts).
 """
 
 from __future__ import annotations

@@ -14,8 +14,9 @@ run() {
   if timeout 1800 "$@" >> "$OUT" 2>> "$OUT.log"; then
     tail -1 "$OUT"
   else
-    # keep $OUT pure JSONL — failures go to the log only
-    echo "FAILED: $label (see $OUT.log)" | tee -a "$OUT.log" >&2
+    # JSON-shaped marker: $OUT stays line-parseable AND failed runs
+    # (possibly with partial records above) are flagged in-band
+    echo "{\"failed\": \"$label\", \"log\": \"$OUT.log\"}" | tee -a "$OUT" >&2
   fi
 }
 
