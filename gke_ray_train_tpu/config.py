@@ -59,9 +59,11 @@ KNOWN_KEYS = frozenset({
 
 def audit_config(config: dict, *, known=KNOWN_KEYS,
                  extra_known=()) -> list:
-    """Warn (once, host-0 callers gate) about unknown keys; returns them."""
+    """Warn (once, host-0 callers gate) about unknown keys; returns them.
+    Keys starting with "_" are comments (JSON has none natively)."""
     unknown = sorted(k for k in config
-                     if k not in known and k not in extra_known)
+                     if k not in known and k not in extra_known
+                     and not k.startswith("_"))
     if unknown:
         logger.warning("config keys not recognized (ignored): %s", unknown)
     if bool(config.get("USE_NESTED_QUANT", False)):

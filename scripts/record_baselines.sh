@@ -16,7 +16,8 @@ run() {
   else
     # JSON-shaped marker: $OUT stays line-parseable AND failed runs
     # (possibly with partial records above) are flagged in-band
-    echo "{\"failed\": \"$label\", \"log\": \"$OUT.log\"}" | tee -a "$OUT" >&2
+    # leading newline: a SIGTERM'd bench can leave $OUT mid-line
+    printf '\n{"failed": "%s", "log": "%s"}\n' "$label" "$OUT.log" | tee -a "$OUT" >&2
   fi
 }
 

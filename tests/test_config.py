@@ -15,11 +15,14 @@ from gke_ray_train_tpu.config import (
 
 
 def test_repo_configs_have_no_unknown_keys():
+    import glob
     import json
     import os
     here = os.path.join(os.path.dirname(__file__), "..", "ray-jobs")
-    for name in ("fine_tune_config.json", "fine_tune_config_70b.json"):
-        with open(os.path.join(here, name)) as f:
+    names = sorted(glob.glob(os.path.join(here, "fine_tune_config*.json")))
+    assert len(names) >= 4  # base, 70b, gemma2-4k, offline-8b
+    for name in names:
+        with open(name) as f:
             cfg = json.load(f)
         assert audit_config(cfg) == [], name
 

@@ -114,6 +114,9 @@ ray job submit --address http://localhost:8265 --runtime-env-json='{
 }' -- python ray-jobs/fine_tune_llama_ray.py
 # (HF_TOKEN reaches the workers from the hf-secret via the pod spec —
 # injecting it here would mask the secret with the local shell's value.)
+# Variant configs select via FINE_TUNE_CONFIG in env_vars, e.g.
+#   "FINE_TUNE_CONFIG": "ray-jobs/fine_tune_config_gemma2_4k.json"
+# (Gemma-2-9B seq-4k packed, fsdp 8 x context 2 sequence parallelism).
 
 # 9c. From-scratch pre-train job
 ray job submit --address http://localhost:8265 --runtime-env-json='{
