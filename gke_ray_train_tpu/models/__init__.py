@@ -6,3 +6,5 @@ from gke_ray_train_tpu.models.transformer import (  # noqa: F401
 from gke_ray_train_tpu.models.decode import greedy_generate  # noqa: F401
 from gke_ray_train_tpu.models.kvcache import (  # noqa: F401
     forward_step, greedy_generate_cached, init_cache)
+from gke_ray_train_tpu.models.qinit import (  # noqa: F401
+    init_quantized_params)

@@ -2,3 +2,5 @@ from gke_ray_train_tpu.ops.norms import rms_norm  # noqa: F401
 from gke_ray_train_tpu.ops.rope import (  # noqa: F401
     apply_rope, rope_frequencies, sinusoidal_positions)
 from gke_ray_train_tpu.ops.attention import dot_product_attention  # noqa: F401
+from gke_ray_train_tpu.ops.a2a_attention import (  # noqa: F401
+    a2a_attention, a2a_supported)

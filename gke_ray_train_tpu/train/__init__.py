@@ -7,3 +7,5 @@ from gke_ray_train_tpu.train.lora import (  # noqa: F401
     LoraConfig, init_lora, lora_specs, merge_lora)
 from gke_ray_train_tpu.train.metrics import (  # noqa: F401
     ThroughputMeter, train_flops_per_token, peak_flops_per_device)
+from gke_ray_train_tpu.train.evaluate import (  # noqa: F401
+    sharded_eval_loss, sharded_eval_sums)

@@ -62,13 +62,21 @@ def test_incremental_steps_match_forward():
 
 
 @pytest.mark.parametrize("variant", ["plain", "lora", "sliding",
-                                     "sinusoidal"])
+                                     "sinusoidal", "gemma2"])
 def test_cached_greedy_matches_oracle(variant):
     kw = {}
     if variant == "sliding":
         kw = dict(block_pattern=("sliding", "global"), sliding_window=8)
     if variant == "sinusoidal":
         kw = dict(positional="sinusoidal", tie_embeddings=True)
+    if variant == "gemma2":
+        # every Gemma-2 mechanism at once: alternating blocks, softcaps,
+        # post-block norms, (1+w) norm scale, gelu, tied + scaled embed
+        kw = dict(block_pattern=("sliding", "global"), sliding_window=8,
+                  attn_softcap=50.0, logit_softcap=30.0,
+                  post_block_norm=True, norm_scale_plus_one=True,
+                  activation="gelu_tanh", tie_embeddings=True,
+                  embed_scale=True)
     cfg, params = _setup(**kw)
     lora = lora_scale = None
     if variant == "lora":
