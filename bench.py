@@ -416,6 +416,19 @@ def bench_decode():
 
 def main():
     mode = os.environ.get("BENCH_MODE", "train")
+    try:
+        jax.devices()
+    except RuntimeError as e:
+        # the tunneled dev TPU can be plain unavailable (observed:
+        # 'UNAVAILABLE: TPU backend setup/compile error' for hours) —
+        # emit an honest machine-readable record instead of crashing
+        # with no bench line at all
+        print(json.dumps({
+            "metric": f"bench {mode} NOT RUN - accelerator backend "
+                      "init failed",
+            "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+            "error": str(e).replace("\n", " ")[:200]}))
+        sys.exit(1)
     {"train": bench_train, "qlora8b": bench_qlora8b,
      "mistral7b-lora": bench_mistral7b_lora,
      "gemma2-4k": bench_gemma2_4k,
