@@ -51,7 +51,8 @@ KNOWN_KEYS = frozenset({
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",
     # TPU / mesh extensions
     "TRAIN_DTYPE", "ATTN_IMPL", "REMAT_POLICY", "MESH_DATA", "MESH_FSDP",
-    "MESH_MODEL", "MESH_CONTEXT", "NUM_SLICES", "SMOKE_TEST",
+    "MESH_MODEL", "MESH_CONTEXT", "MESH_PIPE", "PIPE_MICROBATCHES",
+    "NUM_SLICES", "SMOKE_TEST",
     # profiling / debug (train/profiling.py)
     "PROFILE", "PROFILE_START_STEP", "PROFILE_NUM_STEPS", "DEBUG_NANS",
 })

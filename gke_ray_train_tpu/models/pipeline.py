@@ -1,0 +1,298 @@
+"""GSPMD pipeline parallelism over the ``pipe`` mesh axis.
+
+The reference stack reaches pipeline parallelism through DeepSpeed/
+Megatron-style stage processes; SURVEY.md §2c records PP as optional on
+TPU ("prefer TP+FSDP"). This module closes that row anyway, the TPU way:
+no stage processes, no send/recv framework — the pipeline is ordinary
+jit-traced array code whose *shardings* make XLA emit the stage-to-stage
+transfer as a one-hop ``collective-permute`` on ICI.
+
+Design (the "shift buffer" formulation, cf. the public scaling-book
+pipelining recipe):
+
+- The stacked block params ``[R, ...]`` are viewed as ``[R/P, P, ...]``
+  with the stage dim sharded over ``pipe`` — each device owns the
+  weights of its ``R/P`` contiguous repeats (param memory scales 1/P,
+  same as the reference's stage partitioning).
+- Activations live in a stage buffer ``[P, Bm, S, D]`` (microbatch size
+  ``Bm = B/M``). Each tick: ``jnp.roll`` the buffer by one stage (XLA:
+  collective-permute), feed microbatch ``t`` into stage 0, apply every
+  stage's local repeats in parallel (stage-batched einsums — block-
+  diagonal matmuls, one per device), and harvest stage ``P-1``'s output.
+- ``M + P - 1`` ticks drain ``M`` microbatches; the bubble fraction is
+  ``(P-1)/(M+P-1)`` — raise ``pipe_microbatches`` to amortize it.
+- The whole loop is a ``lax.scan``; autodiff transposes the rolls into
+  reverse permutes, so the backward pass is the mirrored pipeline with
+  no hand-written schedule.
+
+Composability: the batch dim stays sharded over ``(data, fsdp)`` and
+head/ffn dims over ``model`` *inside* the pipeline (the stage dim is
+just one more array axis to GSPMD), so PP composes with DP/FSDP/TP.
+``context`` sharding is the one exclusion — ring/a2a attention do their
+own shard_map over explicit batch specs that a stage-folded batch dim
+does not match; pipelined meshes must keep ``context=1``.
+
+Correctness notes:
+- During warmup/drain ticks stages process zero buffers; their outputs
+  land in ``out`` slots that a later tick overwrites with the real
+  value (mod-M slot arithmetic below), so no masking is needed and the
+  garbage writes get zero cotangent in the backward pass.
+- LoRA adapters ride along as stage-batched einsums (QLoRA bases
+  dequantize per stage-slice); LoRA *dropout* is not supported on a
+  pipelined mesh — the per-repeat rng fold-in would need a per-stage
+  tick-varying key schedule for exactness.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.ops.attention import (
+    dot_product_attention, make_attention_mask)
+from gke_ray_train_tpu.ops.norms import rms_norm
+from gke_ray_train_tpu.ops.rope import apply_rope
+from gke_ray_train_tpu.parallel.mesh import (
+    AXIS_CONTEXT, AXIS_PIPE, BATCH_AXES)
+
+# the folded (stage * microbatch) leading dim of attention inputs
+STAGE_BATCH_AXES = (AXIS_PIPE,) + BATCH_AXES
+
+
+def _constrain(x, mesh: Optional[Mesh], *spec):
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
+
+
+def _proj_p(x, w, lora_p, lora_scale, dtype):
+    """Stage-batched projection: x [P, Bm, S, d_in] @ w [P, d_in, d_out].
+
+    One matmul per stage (block-diagonal to XLA — each device sees only
+    its own stage's operand, so locally this is a plain matmul on the
+    MXU). ``w`` may be a quantized QTensor slice (QLoRA base)."""
+    from gke_ray_train_tpu.ops.quant import maybe_dequantize
+    y = jnp.einsum("pbsd,pdh->pbsh", x, maybe_dequantize(w, dtype))
+    if lora_p is not None:
+        xa = jnp.einsum("pbsd,pdr->pbsr", x, lora_p["a"].astype(dtype))
+        y = y + jnp.einsum("pbsr,prh->pbsh", xa,
+                           lora_p["b"].astype(dtype)) \
+            * jnp.asarray(lora_scale, dtype)
+    return y
+
+
+def _norm_p(x, scale, eps, sp1):
+    """rms_norm with a per-stage scale [P, D] against x [P, Bm, S, D]."""
+    return rms_norm(x, scale[:, None, None, :], eps=eps, scale_plus_one=sp1)
+
+
+def _lora_entry(lora_p, name):
+    return None if lora_p is None or name not in lora_p else lora_p[name]
+
+
+def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
+            window, mesh, lora_p, lora_scale):
+    """posf/segf: stage-folded [Pn*Bm, S]; mask: prebuilt dense mask for
+    this block kind (xla impl) or None (kernel impls build blockwise)."""
+    Pn, Bm, S, D = x.shape
+    hd = cfg.resolved_head_dim
+    H, K = cfg.n_heads, cfg.n_kv_heads
+
+    def lr(name):
+        return _lora_entry(lora_p, name)
+    q = _proj_p(x, lp["wq"], lr("wq"), lora_scale, dtype)
+    k = _proj_p(x, lp["wk"], lr("wk"), lora_scale, dtype)
+    v = _proj_p(x, lp["wv"], lr("wv"), lora_scale, dtype)
+    # fold the stage dim into batch: attention is weightless, so every
+    # stage runs the identical kernel on its own microbatch
+    q = q.reshape(Pn * Bm, S, H, hd)
+    k = k.reshape(Pn * Bm, S, K, hd)
+    v = v.reshape(Pn * Bm, S, K, hd)
+    q = _constrain(q, mesh, STAGE_BATCH_AXES, None, "model", None)
+    k = _constrain(k, mesh, STAGE_BATCH_AXES, None, "model", None)
+    if rope is not None:
+        q = apply_rope(q, posf, rope)
+        k = apply_rope(k, posf, rope)
+    if impl == "xla":
+        out = dot_product_attention(q, k, v, mask, scale=cfg.attn_scale,
+                                    logit_softcap=cfg.attn_softcap)
+    else:
+        from gke_ray_train_tpu.ops.dispatch import attention_dispatch
+        out = attention_dispatch(
+            impl, q, k, v, q_positions=posf, kv_positions=posf,
+            q_segment_ids=segf, kv_segment_ids=segf, causal=True,
+            sliding_window=window, scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_softcap, mesh=mesh,
+            batch_axes=STAGE_BATCH_AXES)
+    out = out.reshape(Pn, Bm, S, H * hd)
+    return _proj_p(out, lp["wo"], lr("wo"), lora_scale, dtype)
+
+
+def _mlp_p(x, lp, cfg: ModelConfig, dtype, lora_p, lora_scale):
+    def lr(name):
+        return _lora_entry(lora_p, name)
+    gate = _proj_p(x, lp["w_gate"], lr("w_gate"), lora_scale, dtype)
+    up = _proj_p(x, lp["w_up"], lr("w_up"), lora_scale, dtype)
+    if cfg.activation == "silu":
+        act = jax.nn.silu(gate)
+    elif cfg.activation == "gelu_tanh":
+        act = jax.nn.gelu(gate, approximate=True)
+    else:
+        raise ValueError(f"unknown activation {cfg.activation}")
+    return _proj_p(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype)
+
+
+def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
+                   dtype, rope, mesh, lora_scale):
+    """Apply each stage's R/P local repeats to its buffer slot.
+
+    Mirrors transformer.repeat_body, stage-batched; scanned over the
+    per-stage repeat dim so depth compiles once. Dense masks (xla impl)
+    are built ONCE per tick per block kind — pos/seg are constant across
+    the repeat scan (same 'build once' rule as transformer.forward)."""
+    eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
+    Pn, Bm, S = pos.shape
+    posf = pos.reshape(Pn * Bm, S)
+    segf = seg.reshape(Pn * Bm, S)
+    masks = {kind: None for kind in set(cfg.block_pattern)}
+    if impl == "xla":
+        for kind in masks:
+            masks[kind] = make_attention_mask(
+                posf, posf, segf, segf, causal=True,
+                sliding_window=(cfg.sliding_window if kind == "sliding"
+                                else None))
+
+    def body(x, xs_slice):
+        layer_slice = xs_slice[0]
+        lora_slice = xs_slice[1] if lora_r is not None else None
+        for p_i, kind in enumerate(cfg.block_pattern):
+            lp = layer_slice[p_i]
+            lo = lora_slice[p_i] if lora_slice is not None else None
+            window = cfg.sliding_window if kind == "sliding" else None
+            h = _norm_p(x, lp["attn_norm"], eps, sp1)
+            h = _attn_p(h, lp, cfg, impl, dtype, rope, posf, segf,
+                        masks[kind], window, mesh, lo, lora_scale)
+            if cfg.post_block_norm:
+                h = _norm_p(h, lp["attn_post_norm"], eps, sp1)
+            x = x + h
+            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+            h = _norm_p(x, lp["mlp_norm"], eps, sp1)
+            h = _mlp_p(h, lp, cfg, dtype, lo, lora_scale)
+            if cfg.post_block_norm:
+                h = _norm_p(h, lp["mlp_post_norm"], eps, sp1)
+            x = x + h
+            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+        return x, None
+
+    if cfg.remat:
+        policy = None
+        if cfg.remat_policy == "dots":
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
+    xs = [blocks_r]
+    if lora_r is not None:
+        xs.append(lora_r)
+    x, _ = jax.lax.scan(body, x, tuple(xs))
+    return x
+
+
+def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
+                    impl: str, dtype, rope, positions, segment_ids,
+                    lora_blocks=None, lora_scale: float = 1.0,
+                    n_microbatches: Optional[int] = None):
+    """Run the stacked decoder blocks pipelined over the ``pipe`` axis.
+
+    x: embedded activations [B, S, D] (batch sharded over (data, fsdp),
+    replicated over pipe). Returns the block-stack output [B, S, D] with
+    the same layout (final norm/unembed run replicated, outside).
+    """
+    Pn = int(mesh.shape[AXIS_PIPE])
+    R = cfg.n_repeats
+    if R % Pn != 0:
+        raise ValueError(
+            f"n_repeats={R} must be divisible by the pipe axis ({Pn})")
+    if mesh.shape[AXIS_CONTEXT] > 1:
+        raise NotImplementedError(
+            "pipelined meshes require context=1 (ring/a2a attention "
+            "shard-maps do not compose with the stage-folded batch dim)")
+    if impl not in ("xla", "flash"):
+        # forward() remaps ring/a2a→flash (with the S%128 dense fallback)
+        # before routing here; direct callers must do the same
+        raise ValueError(
+            f"pipeline_blocks supports attn impl 'xla'/'flash', got "
+            f"{impl!r} — remap context-parallel impls before calling")
+    Rp = R // Pn
+    B, S, D = x.shape
+    M = int(n_microbatches) if n_microbatches else Pn
+    if M < Pn:
+        raise ValueError(
+            f"pipeline microbatches ({M}) must be >= pipe stages ({Pn})")
+    if B % M != 0:
+        raise ValueError(
+            f"batch {B} not divisible by {M} pipeline microbatches")
+    batch_par = math.prod(mesh.shape[a] for a in BATCH_AXES)
+    Bm = B // M
+    if Bm % batch_par != 0:
+        raise ValueError(
+            f"pipeline microbatch size {Bm} (= batch {B} / {M}) must stay "
+            f"divisible by the batch-parallel extent {batch_par}; lower "
+            f"pipe_microbatches or raise the batch")
+
+    # [R, ...] -> [Rp, Pn, ...]: stage-major split of the repeat dim, the
+    # split boundary coincides with the pipe shard boundary so no data
+    # moves; scan then slices one [Pn, ...] layer group per repeat.
+    def to_stages(leaf):
+        return leaf.reshape((Pn, Rp) + leaf.shape[1:]).swapaxes(0, 1)
+
+    blocks_r = jax.tree.map(to_stages, params_blocks)
+    lora_r = (jax.tree.map(to_stages, lora_blocks)
+              if lora_blocks is not None else None)
+
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(S, dtype=jnp.int32), (B, S))
+    if segment_ids is None:
+        segment_ids = jnp.ones((B, S), jnp.int32)
+
+    xm = _constrain(x.reshape(M, Bm, S, D), mesh,
+                    None, BATCH_AXES, None, None)
+    pm = positions.reshape(M, Bm, S)
+    sm = segment_ids.reshape(M, Bm, S)
+
+    buf = _constrain(jnp.zeros((Pn, Bm, S, D), x.dtype), mesh,
+                     AXIS_PIPE, BATCH_AXES, None, None)
+    pbuf = jnp.zeros((Pn, Bm, S), pm.dtype)
+    sbuf = jnp.ones((Pn, Bm, S), sm.dtype)
+    out = _constrain(jnp.zeros((M, Bm, S, D), x.dtype), mesh,
+                     None, BATCH_AXES, None, None)
+
+    def tick(carry, t):
+        buf, pbuf, sbuf, out = carry
+        t_in = jnp.minimum(t, M - 1)
+        # shift: stage p receives stage p-1's activation (one-hop
+        # collective-permute on the pipe ring), stage 0 gets microbatch t
+        buf = jnp.roll(buf, 1, axis=0).at[0].set(
+            jax.lax.dynamic_index_in_dim(xm, t_in, 0, keepdims=False))
+        pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(
+            jax.lax.dynamic_index_in_dim(pm, t_in, 0, keepdims=False))
+        sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(
+            jax.lax.dynamic_index_in_dim(sm, t_in, 0, keepdims=False))
+        buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+        buf = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r, cfg, impl,
+                             dtype, rope, mesh, lora_scale)
+        # harvest the last stage. Warmup ticks (t < Pn-1) write garbage
+        # to slot (t+M-Pn+1) mod M — that slot's real value arrives at
+        # tick slot+Pn-1 > t, overwriting it before the scan ends.
+        slot = jax.lax.rem(t + (M - Pn + 1), M)
+        out = jax.lax.dynamic_update_index_in_dim(out, buf[Pn - 1], slot, 0)
+        return (buf, pbuf, sbuf, out), None
+
+    T = M + Pn - 1
+    (_, _, _, out), _ = jax.lax.scan(
+        tick, (buf, pbuf, sbuf, out), jnp.arange(T))
+    return out.reshape(B, S, D)

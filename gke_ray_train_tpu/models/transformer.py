@@ -111,20 +111,23 @@ def param_specs(cfg: ModelConfig) -> Params:
     (SURVEY.md rows D4/D5) is this table; nothing else.
     """
     def block_specs():
+        # Leading dim = stacked repeats: sharded over `pipe` (pipeline
+        # stages own contiguous layer slices, models/pipeline.py); a
+        # size-1 pipe axis makes this a no-op on non-PP meshes.
         s = {
-            "attn_norm": P(None, None),
-            "wq": P(None, "fsdp", "model"),
-            "wk": P(None, "fsdp", "model"),
-            "wv": P(None, "fsdp", "model"),
-            "wo": P(None, "model", "fsdp"),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, "fsdp", "model"),
-            "w_up": P(None, "fsdp", "model"),
-            "w_down": P(None, "model", "fsdp"),
+            "attn_norm": P("pipe", None),
+            "wq": P("pipe", "fsdp", "model"),
+            "wk": P("pipe", "fsdp", "model"),
+            "wv": P("pipe", "fsdp", "model"),
+            "wo": P("pipe", "model", "fsdp"),
+            "mlp_norm": P("pipe", None),
+            "w_gate": P("pipe", "fsdp", "model"),
+            "w_up": P("pipe", "fsdp", "model"),
+            "w_down": P("pipe", "model", "fsdp"),
         }
         if cfg.post_block_norm:
-            s["attn_post_norm"] = P(None, None)
-            s["mlp_post_norm"] = P(None, None)
+            s["attn_post_norm"] = P("pipe", None)
+            s["mlp_post_norm"] = P("pipe", None)
         return s
 
     specs: Params = {
@@ -251,7 +254,8 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lora: Optional[Params] = None,
             lora_scale: float = 1.0,
             lora_dropout: float = 0.0,
-            lora_rng: Optional[jax.Array] = None) -> jnp.ndarray:
+            lora_rng: Optional[jax.Array] = None,
+            pipe_microbatches: Optional[int] = None) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``lora``: optional adapter pytree from train/lora.py (same block
@@ -261,6 +265,9 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     ``lora_dropout``/``lora_rng``: adapter-input dropout (reference
     LORA_DROPOUT). Active only when BOTH are given — inference and merge
     paths pass neither, so they stay deterministic.
+
+    ``pipe_microbatches``: pipeline microbatch count when the mesh has a
+    ``pipe`` axis > 1 (models/pipeline.py); defaults to the stage count.
     """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -282,7 +289,18 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             llama3_scaling=cfg.rope_scaling))
     x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
 
+    pipe_n = 1
+    if mesh is not None and "pipe" in mesh.shape:
+        pipe_n = int(mesh.shape["pipe"])
+
     impl = cfg.resolved_attn_impl
+    if pipe_n > 1 and impl in ("ring", "a2a") \
+            and mesh.shape[AXIS_CONTEXT] == 1:
+        # on a pipelined mesh with context=1, ring/a2a equal flash — remap
+        # BEFORE the S%128 check below so odd lengths still get the dense
+        # fallback instead of crashing in the kernel (context>1 is
+        # rejected by pipeline_blocks)
+        impl = "flash"
     if impl == "flash" and S % 128 != 0:
         # flash needs a 128-multiple sequence to tile; odd eval/infer
         # lengths fall back to the dense-mask oracle instead of crashing
@@ -290,6 +308,21 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
         # (ADVICE r1: silent fallback)
         _warn_flash_fallback(S)
         impl = "xla"
+
+    if pipe_n > 1:
+        # pipeline-parallel block stack (models/pipeline.py); falls
+        # through to the shared final-norm/unembed tail below
+        if lora is not None and lora_rng is not None and lora_dropout > 0.0:
+            raise NotImplementedError(
+                "LoRA dropout is not supported on a pipelined mesh; set "
+                "LORA_DROPOUT=0 or pipe=1")
+        from gke_ray_train_tpu.models.pipeline import pipeline_blocks
+        x = pipeline_blocks(
+            x, params["blocks"], cfg, mesh, impl=impl, dtype=dtype,
+            rope=rope, positions=positions, segment_ids=segment_ids,
+            lora_blocks=lora["blocks"] if lora is not None else None,
+            lora_scale=lora_scale, n_microbatches=pipe_microbatches)
+        return _unembed(x, params, cfg, dtype, mesh)
 
     # dense masks are shared by every layer of the same kind — build once.
     # Kernel impls (flash/ring) build masks blockwise in-kernel instead.
@@ -352,12 +385,16 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     if drop_keys is not None:
         xs.append(drop_keys)
     x, _ = jax.lax.scan(body, x, tuple(xs))
+    return _unembed(x, params, cfg, dtype, mesh)
 
-    x = rms_norm(x, params["final_norm"], eps=eps, scale_plus_one=sp1)
+
+def _unembed(x, params: Params, cfg: ModelConfig, dtype, mesh):
+    """Shared tail: final norm → (tied) unembedding → logit softcap."""
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps,
+                 scale_plus_one=cfg.norm_scale_plus_one)
     head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
     logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dtype),
                         preferred_element_type=jnp.float32)
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap
-    logits = _constrain(logits, mesh, BATCH_AXES, AXIS_CONTEXT, "model")
-    return logits
+    return _constrain(logits, mesh, BATCH_AXES, AXIS_CONTEXT, "model")

@@ -33,7 +33,7 @@ from gke_ray_train_tpu.parallel.mesh import (
 
 def _flash_sharded(q, k, v, q_positions, kv_positions, q_segment_ids,
                    kv_segment_ids, *, mesh, causal, sliding_window, scale,
-                   logit_softcap, interpret):
+                   logit_softcap, interpret, batch_axes=BATCH_AXES):
     from gke_ray_train_tpu.ops.flash_attention import flash_attention
 
     def local(q, k, v, qp, kp, qs, ks):
@@ -52,8 +52,8 @@ def _flash_sharded(q, k, v, q_positions, kv_positions, q_segment_ids,
             "attn_impl='flash' with a context-sharded mesh would silently "
             "drop cross-shard attention; use attn_impl='ring'")
 
-    qkv_spec = P(BATCH_AXES, None, AXIS_MODEL, None)
-    vec_spec = P(BATCH_AXES, None)
+    qkv_spec = P(batch_axes, None, AXIS_MODEL, None)
+    vec_spec = P(batch_axes, None)
     return shard_map(
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec,
@@ -68,7 +68,11 @@ def attention_dispatch(impl: str, q, k, v, *,
                        causal: bool = True,
                        sliding_window: Optional[int] = None,
                        scale=None, logit_softcap=None, mesh=None,
-                       interpret: Optional[bool] = None) -> jnp.ndarray:
+                       interpret: Optional[bool] = None,
+                       batch_axes=BATCH_AXES) -> jnp.ndarray:
+    """``batch_axes``: mesh axes sharding dim 0 of q/k/v — the default is
+    the (data, fsdp) batch; the pipeline path passes (pipe, data, fsdp)
+    for its stage-folded batch (models/pipeline.py)."""
     B, S = q.shape[:2]
     T = k.shape[1]
     if q_positions is None:
@@ -87,7 +91,8 @@ def attention_dispatch(impl: str, q, k, v, *,
             q, k, v, q_positions, kv_positions, q_segment_ids,
             kv_segment_ids, mesh=mesh, causal=causal,
             sliding_window=sliding_window, scale=scale,
-            logit_softcap=logit_softcap, interpret=interpret)
+            logit_softcap=logit_softcap, interpret=interpret,
+            batch_axes=batch_axes)
     if impl == "ring":
         try:
             from gke_ray_train_tpu.ops.ring_attention import ring_attention
@@ -111,7 +116,8 @@ def attention_dispatch(impl: str, q, k, v, *,
                 q, k, v, q_positions, kv_positions, q_segment_ids,
                 kv_segment_ids, mesh=mesh, causal=causal,
                 sliding_window=sliding_window, scale=scale,
-                logit_softcap=logit_softcap, interpret=interpret)
+                logit_softcap=logit_softcap, interpret=interpret,
+                batch_axes=batch_axes)
         if not a2a_supported(mesh, q.shape[2], k.shape[2]):
             # context axis does not divide the local head counts — ring
             # computes the identical function without that constraint

@@ -8,6 +8,7 @@ from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
     AXIS_FSDP,
     AXIS_MODEL,
     AXIS_CONTEXT,
+    AXIS_PIPE,
     MESH_AXES,
 )
 from gke_ray_train_tpu.parallel.placement import (  # noqa: F401

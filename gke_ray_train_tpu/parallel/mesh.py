@@ -22,6 +22,9 @@ axis        what is sharded over it
 ``fsdp``    batch AND params/optimizer state (ZeRO-3-style, over ICI)
 ``model``   tensor parallelism — attention heads / ffn hidden
 ``context`` sequence/context parallelism — ring attention over ICI
+``pipe``    pipeline parallelism — layer (repeat) dim of the stacked
+            blocks; stages exchange activations via collective-permute
+            (models/pipeline.py)
 ==========  ========================================================
 """
 
@@ -44,7 +47,8 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_MODEL = "model"
 AXIS_CONTEXT = "context"
-MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_CONTEXT)
+AXIS_PIPE = "pipe"
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_CONTEXT, AXIS_PIPE)
 
 # Batch dims are sharded over both DP-like axes; this is the standard GSPMD
 # trick that makes FSDP "just a sharding spec" (SURVEY.md §2c row FSDP).
@@ -57,13 +61,16 @@ class MeshConfig:
 
     Mirrors the reference's infra-shape env vars NUM_NODES /
     NUM_GPUS_PER_NODE (ray-jobs/fine_tune_llama_ray.py:439-441) but as a
-    4-axis logical topology instead of a flat world size.
+    5-axis logical topology instead of a flat world size.
     """
 
     data: int = 1
     fsdp: int = -1
     model: int = 1
     context: int = 1
+    # Pipeline stages. Last mesh dim → stages sit on adjacent ICI
+    # neighbors, so the stage-to-stage activation permute is one hop.
+    pipe: int = 1
     # Number of DCN-connected slices. When >1, the `data` axis is laid out
     # across slices (DCN-outermost) via a hybrid device mesh.
     num_slices: int = 1
@@ -93,7 +100,7 @@ class MeshConfig:
 
     @property
     def shape(self) -> tuple:
-        return (self.data, self.fsdp, self.model, self.context)
+        return (self.data, self.fsdp, self.model, self.context, self.pipe)
 
     @staticmethod
     def from_dict(cfg: dict) -> "MeshConfig":
@@ -104,13 +111,14 @@ class MeshConfig:
             fsdp=int(cfg.get("MESH_FSDP", -1)),
             model=int(cfg.get("MESH_MODEL", 1)),
             context=int(cfg.get("MESH_CONTEXT", 1)),
+            pipe=int(cfg.get("MESH_PIPE", 1)),
             num_slices=int(cfg.get("NUM_SLICES", 1)),
         )
 
 
 def build_mesh(config: MeshConfig | None = None,
                devices: Optional[Sequence[Any]] = None) -> Mesh:
-    """Build the 4-axis device mesh.
+    """Build the 5-axis device mesh.
 
     Single-slice: ``mesh_utils.create_device_mesh`` lets JAX pick a
     device order that maps logical neighbors onto physical ICI neighbors
@@ -126,13 +134,13 @@ def build_mesh(config: MeshConfig | None = None,
                 f"data axis ({config.data}) must be divisible by "
                 f"num_slices ({config.num_slices})")
         per_slice = (config.data // config.num_slices, config.fsdp,
-                     config.model, config.context)
+                     config.model, config.context, config.pipe)
         if all(getattr(d, "slice_index", None) is not None
                for d in devices):
             # real multi-slice hardware: failures here are config bugs
             # (slice count mismatch etc.) and must surface, not degrade
             dev_array = mesh_utils.create_hybrid_device_mesh(
-                per_slice, (config.num_slices, 1, 1, 1), devices=devices)
+                per_slice, (config.num_slices, 1, 1, 1, 1), devices=devices)
         else:
             # fake/CPU devices carry no slice_index attribute — emulate
             # the DCN-outermost layout: contiguous device blocks become

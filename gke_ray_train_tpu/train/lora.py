@@ -17,12 +17,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.config import ModelConfig, PROJ_TARGETS
 from gke_ray_train_tpu.models.transformer import Params
 
 # Default targets = every projection matrix, matching the reference config
 # LORA_TARGET_MODULES (fine_tune_config.json:33: all q/k/v/o/gate/up/down).
-ALL_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+ALL_TARGETS = PROJ_TARGETS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,8 +95,10 @@ def lora_specs(cfg: ModelConfig, lora_cfg: LoraConfig) -> Params:
                 "w_gate": "model", "w_up": "model", "w_down": "fsdp"}
 
     def block():
-        return {t: {"a": P(None, in_spec[t], None),
-                    "b": P(None, None, out_spec[t])}
+        # leading repeat dim follows the base weights onto `pipe`
+        # (no-op while the pipe axis is size 1)
+        return {t: {"a": P("pipe", in_spec[t], None),
+                    "b": P("pipe", None, out_spec[t])}
                 for t in lora_cfg.targets}
 
     return {"blocks": [block() for _ in cfg.block_pattern]}
@@ -106,8 +108,9 @@ def merge_lora(params: Params, lora: Params, lora_cfg: LoraConfig) -> Params:
     """W + (alpha/r) A@B for every adapted matrix — the equivalent of
     peft's merge_and_unload (reference fine_tune_llama_ray.py:349-353),
     but a pure function on pytrees (jit/shard friendly)."""
-    # local import: ops.quant imports ALL_TARGETS from this module at
-    # module scope, so the reverse dependency must stay deferred
+    # deferred import keeps ops.quant (and its pytree registration) out
+    # of LoRA-only runs; the old train↔ops cycle is gone (PROJ_TARGETS
+    # now lives in models.config)
     from gke_ray_train_tpu.ops.quant import (
         dequantize, is_qtensor, maybe_dequantize)
 

@@ -144,13 +144,17 @@ def make_train_step(cfg: ModelConfig,
                     lora_cfg: Optional[LoraConfig] = None,
                     grad_accum: int = 1,
                     schedule: Optional[Callable] = None,
-                    donate: bool = True) -> Callable[[TrainState, Batch],
-                                                     tuple]:
+                    donate: bool = True,
+                    pipe_microbatches: Optional[int] = None
+                    ) -> Callable[[TrainState, Batch], tuple]:
     """Build the jitted ``(state, batch) -> (state, metrics)`` function.
 
     batch: dict with "inputs"/"targets" [B, S] int32, "weights" [B, S]
     float, optional "segment_ids"/"positions" [B, S]. B must be divisible
     by grad_accum; microbatches are scanned in sequence.
+
+    ``pipe_microbatches``: pipeline microbatch count per forward when the
+    mesh has a pipe axis > 1 (models/pipeline.py; default = stage count).
     """
     lora_mode = lora_cfg is not None
     lora_dropout = lora_cfg.dropout if lora_mode else 0.0
@@ -164,12 +168,14 @@ def make_train_step(cfg: ModelConfig,
                              mesh=mesh, lora=trainable,
                              lora_scale=lora_cfg.scale,
                              lora_dropout=lora_dropout,
-                             lora_rng=drop_rng)
+                             lora_rng=drop_rng,
+                             pipe_microbatches=pipe_microbatches)
         else:
             logits = forward(trainable, micro["inputs"], cfg,
                              positions=micro.get("positions"),
                              segment_ids=micro.get("segment_ids"),
-                             mesh=mesh)
+                             mesh=mesh,
+                             pipe_microbatches=pipe_microbatches)
         nll, w = token_nll(logits, micro["targets"], micro["weights"])
         return nll, w
 
@@ -233,7 +239,8 @@ def make_train_step(cfg: ModelConfig,
 
 
 def make_eval_step(cfg: ModelConfig, *, mesh: Optional[Mesh] = None,
-                   lora_cfg: Optional[LoraConfig] = None):
+                   lora_cfg: Optional[LoraConfig] = None,
+                   pipe_microbatches: Optional[int] = None):
     """(state, batch) -> summed (nll, weight) — callers aggregate across
     batches/hosts then divide (exact eval loss, SURVEY.md §5.5)."""
     lora_mode = lora_cfg is not None
@@ -244,7 +251,8 @@ def make_eval_step(cfg: ModelConfig, *, mesh: Optional[Mesh] = None,
                          segment_ids=batch.get("segment_ids"),
                          mesh=mesh,
                          lora=state.lora if lora_mode else None,
-                         lora_scale=lora_cfg.scale if lora_mode else 1.0)
+                         lora_scale=lora_cfg.scale if lora_mode else 1.0,
+                         pipe_microbatches=pipe_microbatches)
         return token_nll(logits, batch["targets"], batch["weights"])
 
     return jax.jit(eval_step)

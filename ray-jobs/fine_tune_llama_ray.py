@@ -237,9 +237,14 @@ def train_loop_per_worker(config: dict):
     state = make_train_state(cfg, opt, jax.random.key(1), mesh=mesh,
                              lora_cfg=lora_cfg, params=params)
 
+    # pipeline-parallel meshes (MESH_PIPE>1) microbatch each forward;
+    # 0/unset = default (one microbatch per stage)
+    pipe_micro = int(config.get("PIPE_MICROBATCHES", 0)) or None
     step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
-                              grad_accum=grad_accum, schedule=schedule)
-    eval_fn_step = make_eval_step(cfg, mesh=mesh, lora_cfg=lora_cfg)
+                              grad_accum=grad_accum, schedule=schedule,
+                              pipe_microbatches=pipe_micro)
+    eval_fn_step = make_eval_step(cfg, mesh=mesh, lora_cfg=lora_cfg,
+                                  pipe_microbatches=pipe_micro)
 
     out_base = config.get("OUTPUT_DIR_BASE", "/tmp/grt_sft")
     sft_dir = os.path.join(out_base, config.get("SFT_SUBDIR_NAME", "sft"))

@@ -12,12 +12,12 @@ from gke_ray_train_tpu.parallel.sharding import (
 
 def test_resolve_fill():
     cfg = MeshConfig(data=2, fsdp=-1).resolve(8)
-    assert cfg.shape == (2, 4, 1, 1)
+    assert cfg.shape == (2, 4, 1, 1, 1)
 
 
 def test_resolve_exact():
     cfg = MeshConfig(data=1, fsdp=2, model=2, context=2).resolve(8)
-    assert cfg.shape == (1, 2, 2, 2)
+    assert cfg.shape == (1, 2, 2, 2, 1)
 
 
 def test_resolve_errors():
@@ -85,7 +85,7 @@ def test_multislice_hybrid_mesh_data_outermost():
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=2, context=1,
                                  num_slices=2), devices)
     assert dict(mesh.shape) == {"data": 2, "fsdp": 2, "model": 2,
-                                "context": 1}
+                                "context": 1, "pipe": 1}
     # data index 0 ↔ first contiguous half (slice 0), index 1 ↔ second
     got0 = [d.id for d in mesh.devices[0].flatten()]
     got1 = [d.id for d in mesh.devices[1].flatten()]

@@ -1,0 +1,210 @@
+"""Pipeline parallelism (models/pipeline.py, SURVEY.md §2c row PP).
+
+Equivalence oracle: the pipelined forward/train step must match the
+plain scanned path bit-for-bit in math (same params, same batch) — the
+pipeline only reorders when each microbatch meets each layer group.
+Runs on the 8-fake-CPU-device mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.models import init_params
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.transformer import forward, param_specs
+from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+from gke_ray_train_tpu.parallel.sharding import shard_tree
+from gke_ray_train_tpu.train import (
+    LoraConfig, make_optimizer, make_train_state, make_train_step,
+    warmup_cosine_schedule)
+from gke_ray_train_tpu.train.lora import init_lora
+
+
+def tiny_cfg(**kw):
+    base = dict(name="pp-tiny", d_model=64, n_layers=4, n_heads=4,
+                n_kv_heads=2, d_ff=128, vocab_size=256, max_seq_len=64,
+                dtype="float32", param_dtype="float32", attn_impl="xla",
+                remat=False)
+    base.update(kw)
+    return ModelConfig(**base)
+
+
+def make_batch(B, S, vocab, seed=0, segments=False):
+    rng = np.random.default_rng(seed)
+    batch = {
+        "inputs": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, vocab, (B, S)), jnp.int32),
+        "weights": jnp.ones((B, S), jnp.float32),
+    }
+    if segments:
+        half = S // 2
+        seg = np.concatenate([np.full((B, half), 1), np.full((B, S - half), 2)],
+                             axis=1)
+        pos = np.concatenate([np.arange(half), np.arange(S - half)])
+        batch["segment_ids"] = jnp.asarray(seg, jnp.int32)
+        batch["positions"] = jnp.asarray(np.tile(pos, (B, 1)), jnp.int32)
+    return batch
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return build_mesh(MeshConfig(data=2, fsdp=2, model=1, context=1, pipe=2))
+
+
+@pytest.mark.parametrize("n_micro", [2, 4])
+def test_pipeline_forward_matches_plain(pp_mesh, n_micro):
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = make_batch(16, 32, cfg.vocab_size)["inputs"]
+
+    ref = forward(params, tokens, cfg)  # no mesh: plain scan path
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(
+        lambda p, t: forward(p, t, cfg, mesh=pp_mesh,
+                             pipe_microbatches=n_micro))(sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_forward_packed_segments(pp_mesh):
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(1))
+    batch = make_batch(8, 32, cfg.vocab_size, seed=3, segments=True)
+
+    ref = forward(params, batch["inputs"], cfg,
+                  positions=batch["positions"],
+                  segment_ids=batch["segment_ids"])
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(
+        lambda p, b: forward(p, b["inputs"], cfg,
+                             positions=b["positions"],
+                             segment_ids=b["segment_ids"],
+                             mesh=pp_mesh))(sharded, batch)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_gemma_pattern(pp_mesh):
+    """Sliding/global alternation + softcaps + post norms survive the
+    stage-batched body (R=2 repeats of a 2-block pattern, pipe=2)."""
+    cfg = tiny_cfg(n_layers=4, block_pattern=("sliding", "global"),
+                   sliding_window=8, attn_softcap=50.0, logit_softcap=30.0,
+                   post_block_norm=True, norm_scale_plus_one=True,
+                   activation="gelu_tanh")
+    params = init_params(cfg, jax.random.key(2))
+    tokens = make_batch(8, 32, cfg.vocab_size, seed=5)["inputs"]
+
+    ref = forward(params, tokens, cfg)
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=pp_mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_train_step_matches_plain(pp_mesh):
+    """Full jitted train step (grad accum 2) on the PP mesh reproduces
+    the single-device step: loss and updated-param agreement is the
+    end-to-end gradient-correctness oracle for the pipelined backward."""
+    cfg = tiny_cfg(remat=True)
+    schedule = warmup_cosine_schedule(1e-3, 100)
+    # grad_accum=2 then pipe microbatching: 16 -> micro 8 -> Bm 4
+    batch = make_batch(16, 32, cfg.vocab_size, seed=7)
+
+    opt_ref = make_optimizer(schedule)
+    state_ref = make_train_state(cfg, opt_ref, jax.random.key(0))
+    step_ref = make_train_step(cfg, opt_ref, grad_accum=2,
+                               schedule=schedule, donate=False)
+    state_ref2, m_ref = step_ref(state_ref, batch)
+
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=pp_mesh)
+    step = make_train_step(cfg, opt, mesh=pp_mesh, grad_accum=2,
+                           schedule=schedule, donate=False,
+                           pipe_microbatches=2)
+    state2, m = step(state, batch)
+
+    assert np.isfinite(float(m["loss"]))
+    np.testing.assert_allclose(float(m["loss"]), float(m_ref["loss"]),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(m["grad_norm"]),
+                               float(m_ref["grad_norm"]), rtol=1e-3)
+    got_leaf = np.asarray(state2.params["blocks"][0]["wq"])
+    ref_leaf = np.asarray(state_ref2.params["blocks"][0]["wq"])
+    np.testing.assert_allclose(got_leaf, ref_leaf, rtol=2e-3, atol=2e-5)
+
+
+def test_pipeline_lora_matches_plain(pp_mesh):
+    """LoRA adapters (no dropout) through the pipelined path."""
+    cfg = tiny_cfg()
+    lcfg = LoraConfig(r=4, alpha=8)
+    params = init_params(cfg, jax.random.key(0))
+    lora = init_lora(cfg, lcfg, jax.random.key(1))
+    # B=0 makes adapters a no-op; perturb so the test has teeth
+    lora = jax.tree.map(lambda x: x + 0.01, lora)
+    tokens = make_batch(8, 32, cfg.vocab_size, seed=9)["inputs"]
+
+    ref = forward(params, tokens, cfg, lora=lora, lora_scale=lcfg.scale)
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(
+        lambda p, lo, t: forward(p, t, cfg, mesh=pp_mesh, lora=lo,
+                                 lora_scale=lcfg.scale))(
+        sharded, lora, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_flash_kernel_matches_plain(pp_mesh):
+    """attn_impl='flash' through the pipelined path: exercises the
+    stage-folded (pipe, data, fsdp) batch spec handed to the kernel's
+    shard_map (ops/dispatch.py batch_axes) — Pallas interpret mode on
+    the fake-CPU devices, 128-multiple sequence to keep the kernel."""
+    cfg = tiny_cfg(attn_impl="flash", max_seq_len=128)
+    params = init_params(cfg, jax.random.key(4))
+    tokens = make_batch(8, 128, cfg.vocab_size, seed=11)["inputs"]
+
+    ref = forward(params, tokens, cfg)  # flash (interpret), unsharded
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=pp_mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_ring_remap_odd_seq_falls_back(pp_mesh):
+    """ring on a pipelined context=1 mesh remaps to flash; a non-128
+    sequence must then take the dense fallback, not crash the kernel."""
+    cfg = tiny_cfg(attn_impl="ring")
+    params = init_params(cfg, jax.random.key(5))
+    tokens = make_batch(8, 32, cfg.vocab_size, seed=13)["inputs"]
+
+    import dataclasses
+    ref = forward(params, tokens, dataclasses.replace(cfg, attn_impl="xla"))
+    sharded = shard_tree(params, pp_mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=pp_mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_error_gates(pp_mesh):
+    cfg = tiny_cfg()
+    params = init_params(cfg, jax.random.key(0))
+    tokens = make_batch(8, 32, cfg.vocab_size)["inputs"]
+
+    with pytest.raises(ValueError, match="microbatches"):
+        forward(params, tokens, cfg, mesh=pp_mesh, pipe_microbatches=1)
+    with pytest.raises(ValueError, match="divisible"):
+        forward(params, tokens, cfg, mesh=pp_mesh, pipe_microbatches=3)
+    cfg_odd = tiny_cfg(n_layers=3)
+    params_odd = init_params(cfg_odd, jax.random.key(0))
+    with pytest.raises(ValueError, match="n_repeats"):
+        forward(params_odd, tokens, cfg_odd, mesh=pp_mesh)
+
+    ctx_mesh = build_mesh(
+        MeshConfig(data=1, fsdp=2, model=1, context=2, pipe=2))
+    cfg_ring = tiny_cfg(attn_impl="ring")
+    with pytest.raises(NotImplementedError, match="context"):
+        forward(params, tokens, cfg_ring, mesh=ctx_mesh)
