@@ -41,7 +41,16 @@ import jax.numpy as jnp
 # A/B knob for every remat-enabled mode: "full" (recompute the block in
 # backward, lowest memory) vs "dots" (save matmul outputs). Validated
 # here so a typo fails before an expensive TPU run, not silently.
-BENCH_REMAT_POLICY = os.environ.get("BENCH_REMAT", "full")
+# Per-mode default when BENCH_REMAT is unset: "dots" where the saved
+# matmul outputs fit (measured 25,587 tok/s/chip @ 55.8% MFU vs 24,285 @
+# 53.0% for "full" on the default workload, v5e chip r4); "full" where
+# they blow the 16 GB HBM — the full-family-dims LoRA modes (qlora8b
+# with dots: 22.1 GB requested) and the packed-4k gemma mode, whose
+# seq-4096 activations are the problem (dots: 19.2 GB requested).
+_REMAT_DEFAULTS = {"qlora8b": "full", "mistral7b-lora": "full",
+                   "gemma2-4k": "full"}
+BENCH_REMAT_POLICY = os.environ.get("BENCH_REMAT") or _REMAT_DEFAULTS.get(
+    os.environ.get("BENCH_MODE", "train"), "dots")
 if BENCH_REMAT_POLICY not in ("full", "dots"):
     raise SystemExit(f"BENCH_REMAT={BENCH_REMAT_POLICY!r}; use full|dots")
 

@@ -193,7 +193,7 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
         return QTensor(codes, scales, kind, group)
 
     # per-(pattern-position, key): stream the R per-layer tensors
-    from gke_ray_train_tpu.train.lora import ALL_TARGETS as _PROJ_KEYS
+    from gke_ray_train_tpu.models.config import PROJ_TARGETS as _PROJ_KEYS
     blocks = []
     for p in range(P_):
         blk: Dict[str, jax.Array] = {}

@@ -6,5 +6,14 @@ from gke_ray_train_tpu.models.transformer import (  # noqa: F401
 from gke_ray_train_tpu.models.decode import greedy_generate  # noqa: F401
 from gke_ray_train_tpu.models.kvcache import (  # noqa: F401
     forward_step, greedy_generate_cached, init_cache)
-from gke_ray_train_tpu.models.qinit import (  # noqa: F401
-    init_quantized_params)
+
+
+def __getattr__(name):
+    # lazy (PEP 562): qinit imports ops.quant, which imports
+    # models.config — an eager import here would make
+    # `import gke_ray_train_tpu.ops.quant` re-enter ops.quant through
+    # this package __init__ while it is still initializing
+    if name == "init_quantized_params":
+        from gke_ray_train_tpu.models.qinit import init_quantized_params
+        return init_quantized_params
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

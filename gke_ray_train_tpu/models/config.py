@@ -14,6 +14,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
+# The seven projection matrices of every decoder block — the canonical
+# target list for LoRA adapters (reference LORA_TARGET_MODULES,
+# fine_tune_config.json:33) and weight quantization. Lives here (leaf
+# module, no deps) so ops/quant.py and train/lora.py can both import it
+# without a train↔ops cycle.
+PROJ_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:

@@ -21,6 +21,7 @@ shard with the same spec tree as fp32 ones.
 from __future__ import annotations
 
 import dataclasses
+import os
 from functools import partial
 from typing import Any, Optional
 
@@ -40,34 +41,36 @@ NF4_CODEBOOK = np.array([
 DEFAULT_GROUP = 64
 # weights the fine-tune quantizes — same set LoRA adapts (the reference's
 # bnb pass covers LLAMA_TARGET_MODULES, fine_tune_config.json:30-33);
-# sharing lora's constant keeps quantize→merge→export structurally in sync
-from gke_ray_train_tpu.train.lora import ALL_TARGETS as QUANT_TARGETS
+# the shared canonical tuple lives in models.config (leaf module) so
+# quantize→merge→export stay structurally in sync without a train↔ops cycle
+from gke_ray_train_tpu.models.config import PROJ_TARGETS as QUANT_TARGETS
 
 _U4_PROBED = None
 
 
 def _nf4_store_dtype():
-    """uint4 (2 codes/byte) where the backend supports it, else int8.
+    """Storage dtype for NF4 codes: int8 by default, uint4 by opt-in.
 
-    Probed once per process with the exact lifecycle QLoRA codes have —
-    created by one jit, then consumed as an argument by ANOTHER jit (the
-    train step): some runtimes (e.g. the tunneled axon backend in this
-    dev environment) create sub-byte arrays fine but blow up with a
-    RecursionError when a second executable re-lays them out at
-    dispatch, so a bare create/device_get probe passes and the first
-    real train step dies."""
+    uint4 halves the codes' HBM footprint (2 codes/byte) but sub-byte
+    arrays are fragile as *executable arguments*: when a consuming jit
+    wants a different tiled layout than the producing jit emitted, the
+    dispatch-time relayout ``device_put`` recursively re-enters jit and
+    dies with a RecursionError. Whether that relayout happens depends on
+    layout assignment (and, on the tunneled dev TPU, on the remote
+    compile cache) — a runtime probe passes or fails NON-deterministically
+    for the same program, which is worse than either behavior. So the
+    default is the dtype that always works; set ``QUANT_STORE=uint4`` on
+    backends where the sub-byte path is verified."""
     global _U4_PROBED
     if _U4_PROBED is None:
-        if not hasattr(jnp, "uint4"):
-            _U4_PROBED = jnp.int8
-        else:
-            try:
-                x = jax.jit(lambda: jnp.zeros((8,), jnp.uint4))()
-                jax.device_get(x)
-                jax.device_get(jax.jit(lambda a: a.astype(jnp.int8))(x))
-                _U4_PROBED = jnp.uint4
-            except Exception:  # noqa: BLE001 - any backend failure → int8
-                _U4_PROBED = jnp.int8
+        want = os.environ.get("QUANT_STORE", "int8").lower()
+        if want not in ("int8", "uint4"):
+            raise ValueError(f"QUANT_STORE={want!r}; use int8|uint4")
+        if want == "uint4" and not hasattr(jnp, "uint4"):
+            raise ValueError(
+                "QUANT_STORE=uint4 requested but this JAX build has no "
+                "jnp.uint4")
+        _U4_PROBED = jnp.uint4 if want == "uint4" else jnp.int8
     return _U4_PROBED
 
 
