@@ -19,8 +19,8 @@ LoRA on one chip), ``mistral7b-lora`` (BASELINE config 4: full
 Mistral-7B dims, sliding-window attention, NF4 base + LoRA),
 ``gemma2-4k`` (BASELINE config 5 shape: Gemma-2 pattern — alternating
 sliding/global, softcaps, tied embeddings — packed seq 4096),
-``seq4k`` (packed 4k llama-proxy), ``decode`` (KV-cache greedy decode
-tokens/sec).
+``seq4k`` (packed 4k llama-proxy), ``moe`` (Mixtral-pattern 8-expert
+top-2 MoE proxy), ``decode`` (KV-cache greedy decode tokens/sec).
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -384,6 +384,57 @@ def bench_seq4k():
         compare_baseline=False)
 
 
+def bench_moe():
+    """Mixtral-pattern MoE train step (8 experts, top-2, router aux) at
+    a single-chip proxy size — the EP/MoE path's measured shape."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import mixtral_8x7b
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        train_flops_per_token, warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.metrics import peak_flops_per_device
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        # ~2.6B total / ~1B active with every MoE mechanism live
+        size = dict(d_model=1024, n_layers=12, n_heads=16, n_kv_heads=8,
+                    d_ff=4096, vocab_size=32768)
+        B, S, steps = 8, 1024, 10
+    else:
+        size = dict(d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_ff=256, vocab_size=2048)
+        B, S, steps = 4, 128, 2
+    cfg = dataclasses.replace(
+        mixtral_8x7b(), name="moe-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        remat_policy=BENCH_REMAT_POLICY, **size)
+
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, schedule=schedule)
+
+    dt_get, dt_block, loss = _run_timed_train(
+        step, state, _rand_batch(B, S, cfg.vocab_size), steps)
+    tokens = B * S * steps
+    tps_chip = tokens / dt_get / n_dev
+    # active-param FLOPs (router + top-2 experts), ModelConfig.active_param_count
+    mfu = (tokens / dt_get) * train_flops_per_token(cfg, S) / (
+        peak_flops_per_device() * n_dev)
+    _emit(
+        f"tokens/sec/chip Mixtral-pattern MoE train step (8exp top2, "
+        f"{cfg.d_model}d/{cfg.n_layers}L seq {S}, "
+        f"{devices[0].device_kind} x{n_dev})",
+        tps_chip, "tokens/sec/chip",
+        {"mfu_active_flops": round(mfu, 4), "loss": round(loss, 4),
+         "timing": {"device_get_s": round(dt_get, 4),
+                    "block_until_ready_s": round(dt_block, 4)}},
+        compare_baseline=False)
+
+
 def bench_decode():
     """KV-cache greedy decode tokens/sec (models/kvcache.py)."""
     import dataclasses
@@ -441,7 +492,8 @@ def main():
     {"train": bench_train, "qlora8b": bench_qlora8b,
      "mistral7b-lora": bench_mistral7b_lora,
      "gemma2-4k": bench_gemma2_4k,
-     "seq4k": bench_seq4k, "decode": bench_decode}[mode]()
+     "seq4k": bench_seq4k, "moe": bench_moe,
+     "decode": bench_decode}[mode]()
 
 
 if __name__ == "__main__":

@@ -34,18 +34,22 @@ from gke_ray_train_tpu.parallel.sharding import tree_shardings
 
 
 def _hf_layer_names(cfg: ModelConfig, i: int) -> Dict[str, str]:
-    """our-key → HF tensor name for decoder layer i."""
+    """our-key → HF tensor name for decoder layer i (per-layer tensors;
+    MoE expert banks are per-(layer, expert), see _hf_expert_names)."""
     base = f"model.layers.{i}"
     names = {
         "wq": f"{base}.self_attn.q_proj.weight",
         "wk": f"{base}.self_attn.k_proj.weight",
         "wv": f"{base}.self_attn.v_proj.weight",
         "wo": f"{base}.self_attn.o_proj.weight",
-        "w_gate": f"{base}.mlp.gate_proj.weight",
-        "w_up": f"{base}.mlp.up_proj.weight",
-        "w_down": f"{base}.mlp.down_proj.weight",
         "attn_norm": f"{base}.input_layernorm.weight",
     }
+    if cfg.n_experts > 0:  # Mixtral layout
+        names["router"] = f"{base}.block_sparse_moe.gate.weight"
+    else:
+        names["w_gate"] = f"{base}.mlp.gate_proj.weight"
+        names["w_up"] = f"{base}.mlp.up_proj.weight"
+        names["w_down"] = f"{base}.mlp.down_proj.weight"
     if cfg.post_block_norm:  # Gemma-2 has four norms per block
         names["attn_post_norm"] = f"{base}.post_attention_layernorm.weight"
         names["mlp_norm"] = f"{base}.pre_feedforward_layernorm.weight"
@@ -54,7 +58,21 @@ def _hf_layer_names(cfg: ModelConfig, i: int) -> Dict[str, str]:
         names["mlp_norm"] = f"{base}.post_attention_layernorm.weight"
     return names
 
-_TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+# Mixtral expert naming: w1 = gate, w2 = down, w3 = up
+_EXPERT_HF = {"w_gate": "w1", "w_up": "w3", "w_down": "w2"}
+_EXPERT_KEYS = ("w_gate", "w_up", "w_down")
+
+
+def _hf_expert_names(i: int, e: int) -> Dict[str, str]:
+    base = f"model.layers.{i}.block_sparse_moe.experts.{e}"
+    return {k: f"{base}.{v}.weight" for k, v in _EXPERT_HF.items()}
+
+
+# HF stores every projection (and the Mixtral router) as [out, in];
+# this pytree keeps [in, out] so matmuls read x @ w
+_TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+               "router"}
 
 
 def _open_shards(model_dir: str):
@@ -117,55 +135,86 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
         return jax.device_put(arr, spec_path)
 
     def _accumulate(shape, dtype, sharding, slices):
-        """Stream per-layer [1, ...] device slices into a [R, ...] leaf
-        living at its final (sharded) home: zeros-allocate once, then one
-        donated dynamic_update_slice per layer. Host RAM peak stays one
-        layer tensor (VERDICT r3 weak #4a: np.stack of all R slices held
-        ~37 GB host RAM for a single 70B leaf)."""
+        """Stream per-slice [1, ..] (or [1, 1, ..] for expert banks)
+        device arrays into a stacked leaf living at its final (sharded)
+        home: zeros-allocate once, then one donated dynamic_update_slice
+        per slice. Host RAM peak stays one tensor (VERDICT r3 weak #4a:
+        np.stack of all R slices held ~37 GB host RAM for a single 70B
+        leaf). ``slices`` yields (lead-index tuple, array)."""
         kw = {} if sharding is None else {"out_shardings": sharding}
         out = jax.jit(lambda: jnp.zeros(shape, dtype), **kw)()
-        zeros_tail = (0,) * (len(shape) - 1)
         upd = jax.jit(
-            lambda o, a, r: jax.lax.dynamic_update_slice(
-                o, a.astype(dtype), (r,) + zeros_tail),
+            lambda o, a, idx: jax.lax.dynamic_update_slice(
+                o, a.astype(dtype),
+                tuple(idx) + (0,) * (len(shape) - len(idx))),
             donate_argnums=(0,))
-        for r, a in slices:
-            out = upd(out, a, r)
+        for idx, a in slices:
+            out = upd(out, a, idx)
         return out
 
-    def load_stacked(p: int, key: str):
+    def _indices_and_names(p: int, key: str, experts: bool):
+        """(lead index tuples, idx→tensor-name) for a stacked leaf:
+        [R] per-layer tensors, or [R, E] per-(layer, expert) for MoE
+        banks (Mixtral layout)."""
+        if experts:
+            idxs = [(r, e) for r in range(R)
+                    for e in range(cfg.n_experts)]
+            return idxs, (lambda idx: _hf_expert_names(
+                idx[0] * P_ + p, idx[1])[key])
+        return [(r,) for r in range(R)], (lambda idx: _hf_layer_names(
+            cfg, idx[0] * P_ + p)[key])
+
+    def _slice_sharding(spec, n_lead: int):
+        """Sharding for ONE streamed slice: the full leaf's spec with
+        its lead (stack) dims replaced by None — a [1, 1, D, F] expert
+        slice cannot be partitioned along its size-1 expert dim even
+        though the assembled [R, E, D, F] leaf is."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        return NamedSharding(mesh, PartitionSpec(
+            *([None] * n_lead + list(spec)[n_lead:])))
+
+    def load_stacked(p: int, key: str, *, experts: bool = False):
+        idxs, name = _indices_and_names(p, key, experts)
+        n_lead = len(idxs[0])
         tgt = shardings["blocks"][p][key] if shardings is not None else None
-        first = _maybe_t(read(_hf_layer_names(cfg, p)[key]), key)
+        # idxs[0] reuses the shape-probe read (one disk read per tensor)
+        first = _maybe_t(read(name(idxs[0])), key)
+        slice_tgt = (None if tgt is None else
+                     _slice_sharding(specs["blocks"][p][key], n_lead))
 
         def slices():
-            for r in range(R):
-                # r=0 reuses the shape-probe read (one disk read per
-                # layer, not two for layer 0)
-                w = first if r == 0 else _maybe_t(
-                    read(_hf_layer_names(cfg, r * P_ + p)[key]), key)
-                a = w[None]
-                yield r, (a if tgt is None else jax.device_put(a, tgt))
+            for idx in idxs:
+                w = first if idx == idxs[0] else _maybe_t(
+                    read(name(idx)), key)
+                a = w[(None,) * n_lead]
+                yield idx, (a if slice_tgt is None
+                            else jax.device_put(a, slice_tgt))
 
-        return _accumulate((R,) + first.shape, pdt, tgt, slices())
+        lead = tuple(d + 1 for d in idxs[-1])
+        return _accumulate(lead + first.shape, pdt, tgt, slices())
 
-    def load_quantized(p: int, key: str):
-        """Per-layer-slice quantize: device sees one [1, D, F] slice at
-        a time; codes/scales stream straight into their device-resident
-        (sharded) homes — neither the bf16 tree nor the stacked codes
-        ever exist in host RAM (VERDICT r3 weak #4a)."""
+    def load_quantized(p: int, key: str, *, experts: bool = False):
+        """Per-slice quantize: device sees one layer (or one (layer,
+        expert)) slice at a time; codes/scales stream straight into
+        their device-resident (sharded) homes — neither the bf16 tree
+        nor the stacked codes ever exist in host RAM (VERDICT r3 weak
+        #4a)."""
         from jax.sharding import NamedSharding
         from gke_ray_train_tpu.ops.quant import (
             QTensor, quant_specs, quantize_tensor)
+        idxs, name = _indices_and_names(p, key, experts)
+        n_lead = len(idxs[0])
 
-        def qt_for(r):
-            w = _maybe_t(read(_hf_layer_names(cfg, r * P_ + p)[key]), key)
-            return quantize_tensor(jnp.asarray(w, jnp.bfloat16)[None],
-                                   quantize)
+        def qt_for(idx):
+            w = _maybe_t(read(name(idx)), key)
+            return quantize_tensor(
+                jnp.asarray(w, jnp.bfloat16)[(None,) * n_lead], quantize)
 
-        first = qt_for(0)
+        first = qt_for(idxs[0])
         kind, group = first.kind, first.group
-        c_shape = (R,) + first.codes.shape[1:]
-        s_shape = (R,) + first.scales.shape[1:]
+        lead = tuple(d + 1 for d in idxs[-1])
+        c_shape = lead + first.codes.shape[n_lead:]
+        s_shape = lead + first.scales.shape[n_lead:]
         c_shard = s_shard = None
         if mesh is not None:
             q_spec = quant_specs(specs["blocks"][p][key], QTensor(
@@ -175,7 +224,7 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
             c_shard = NamedSharding(mesh, q_spec.codes)
             s_shard = NamedSharding(mesh, q_spec.scales)
 
-        # one read+quantize pass per layer, feeding BOTH accumulators
+        # one read+quantize pass per tensor, feeding BOTH accumulators
         kwc = {} if c_shard is None else {"out_shardings": c_shard}
         kws = {} if s_shard is None else {"out_shardings": s_shard}
         codes = jax.jit(lambda: jnp.zeros(c_shape, first.codes.dtype),
@@ -183,13 +232,13 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
         scales = jax.jit(lambda: jnp.zeros(s_shape, first.scales.dtype),
                          **kws)()
         upd = jax.jit(
-            lambda o, a, r: jax.lax.dynamic_update_slice(
-                o, a, (r,) + (0,) * (len(o.shape) - 1)),
+            lambda o, a, idx: jax.lax.dynamic_update_slice(
+                o, a, tuple(idx) + (0,) * (len(o.shape) - n_lead)),
             donate_argnums=(0,))
-        for r in range(R):
-            qt = first if r == 0 else qt_for(r)
-            codes = upd(codes, qt.codes, r)
-            scales = upd(scales, qt.scales, r)
+        for idx in idxs:
+            qt = first if idx == idxs[0] else qt_for(idx)
+            codes = upd(codes, qt.codes, idx)
+            scales = upd(scales, qt.scales, idx)
         return QTensor(codes, scales, kind, group)
 
     # per-(pattern-position, key): stream the R per-layer tensors
@@ -203,6 +252,9 @@ def load_hf_checkpoint(model_dir: str, cfg: ModelConfig, *,
                 blk[key] = load_quantized(p, key)
                 continue
             blk[key] = load_stacked(p, key)
+        for key in (_EXPERT_KEYS if cfg.n_experts > 0 else ()):
+            blk[key] = (load_quantized(p, key, experts=True) if quantize
+                        else load_stacked(p, key, experts=True))
         blocks.append(blk)
 
     params: Params = {
@@ -331,6 +383,11 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, out_dir: str,
             for key, tname in names.items():
                 arr = jax.device_get(blk[key][r])
                 w.add(tname, to_np(_maybe_t(np.asarray(arr), key)))
+            for key in (_EXPERT_KEYS if cfg.n_experts > 0 else ()):
+                for e in range(cfg.n_experts):
+                    arr = jax.device_get(blk[key][r, e])
+                    w.add(_hf_expert_names(r * P_ + p, e)[key],
+                          to_np(_maybe_t(np.asarray(arr), key)))
     w.finish()
     write_hf_config(cfg, out_dir, dtype)
 
@@ -352,4 +409,7 @@ def write_hf_config(cfg: ModelConfig, out_dir: str,
             "rms_norm_eps": cfg.norm_eps,
             "tie_word_embeddings": cfg.tie_embeddings,
             "torch_dtype": dtype,
+            **({"num_local_experts": cfg.n_experts,
+                "num_experts_per_tok": cfg.expert_top_k}
+               if cfg.n_experts else {}),
         }, f, indent=2)

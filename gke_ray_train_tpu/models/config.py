@@ -48,6 +48,19 @@ class ModelConfig:
     sliding_window: Optional[int] = None
 
     activation: str = "silu"                # "silu" | "gelu_tanh"
+
+    # Mixture-of-Experts (ops/moe.py). n_experts=0 → dense MLP. When >0,
+    # every block's MLP becomes a top-k routed expert bank (Mixtral
+    # pattern); experts shard over the `model` axis = expert parallelism
+    # under GSPMD (SURVEY.md §2c row EP).
+    n_experts: int = 0
+    expert_top_k: int = 2
+    # per-expert token capacity = capacity_factor * top_k * S / E
+    # (GShard-style static capacity; overflow tokens drop to the
+    # residual path)
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01           # Switch load-balance loss weight
+
     tie_embeddings: bool = False
     embed_scale: bool = False               # x *= sqrt(d_model) after embed
     norm_scale_plus_one: bool = False       # Gemma (1 + scale) RMSNorm
@@ -126,12 +139,27 @@ class ModelConfig:
         return self.n_layers // len(self.block_pattern)
 
     def param_count(self) -> int:
-        """Exact dense param count (used for MFU math, train/metrics.py)."""
+        """Exact TOTAL param count (storage truth; for MoE this counts
+        every expert). MFU math uses active_param_count()."""
+        return self._count_params(self.n_experts)
+
+    def active_param_count(self) -> int:
+        """Params touched per token: for MoE, the router plus the top-k
+        experts only — the FLOP-relevant count (train/metrics.py)."""
+        return self._count_params(min(self.expert_top_k, self.n_experts)
+                                  if self.n_experts else 0)
+
+    def _count_params(self, experts_counted: int) -> int:
         hd = self.resolved_head_dim
         attn = (self.d_model * self.n_heads * hd          # wq
                 + 2 * self.d_model * self.n_kv_heads * hd  # wk, wv
                 + self.n_heads * hd * self.d_model)        # wo
-        mlp = 3 * self.d_model * self.d_ff
+        ffn = 3 * self.d_model * self.d_ff
+        if self.n_experts:
+            mlp = (self.d_model * self.n_experts          # router
+                   + experts_counted * ffn)
+        else:
+            mlp = ffn
         norms = 2 * self.d_model + (2 * self.d_model if self.post_block_norm
                                     else 0)
         per_layer = attn + mlp + norms
@@ -178,6 +206,16 @@ def mistral_7b(**kw) -> ModelConfig:
         **kw)
 
 
+def mixtral_8x7b(**kw) -> ModelConfig:
+    """Mixtral 8x7B: Mistral-7B dims with an 8-expert top-2 MoE MLP per
+    layer (public architecture description; 47B total / ~13B active)."""
+    return ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336, max_seq_len=4096,
+        rope_theta=1e6, n_experts=8, expert_top_k=2,
+        **kw)
+
+
 def gemma2_9b(**kw) -> ModelConfig:
     return ModelConfig(
         name="gemma2-9b", vocab_size=256128, d_model=3584, n_layers=42,
@@ -218,6 +256,7 @@ PRESETS = {
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "mistral-7b": mistral_7b,
+    "mixtral-8x7b": mixtral_8x7b,
     "gemma2-9b": gemma2_9b,
 }
 
@@ -232,6 +271,8 @@ def preset_for_model_id(model_id: str, **kw) -> ModelConfig:
         # checkpoints were trained without it
         kw.setdefault("rope_scaling", _LLAMA31_SCALING if is_31 else None)
         return fn(**kw)
+    if "mixtral" in mid:
+        return mixtral_8x7b(**kw)
     if "mistral" in mid:
         if any(t in mid for t in ("v0.1", "v0.2")):
             kw.setdefault("vocab_size", 32000)

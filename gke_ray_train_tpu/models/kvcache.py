@@ -144,7 +144,15 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
                              scale_plus_one=sp1)
             x = x + h
             h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale)
+            if cfg.n_experts > 0:
+                # routed expert MLP; the load-balance aux is a training
+                # loss term and is discarded at inference
+                from gke_ray_train_tpu.ops.moe import moe_mlp
+                h, _ = moe_mlp(h, lp["router"], lp["w_gate"], lp["w_up"],
+                               lp["w_down"], cfg, dtype)
+            else:
+                h = _mlp(h, lp, cfg, dtype, lora_p=lo,
+                         lora_scale=lora_scale)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
                              scale_plus_one=sp1)

@@ -61,7 +61,23 @@ def init_quantized_params(cfg: ModelConfig, key: jax.Array, *,
     normal, 1/sqrt(2*n_layers) residual-writer scaling), same sharding
     rules (quant_specs adapts each spec to the codes/scales shapes).
     Norms/embed/lm_head stay full precision, like the reference's bnb
-    pass which only rewrites the proj modules."""
+    pass which only rewrites the proj modules.
+
+    MoE configs take the simple path (full init, then quantize the
+    expert bank): the expert leaves are 4-D and per-slice streaming
+    buys less there since each expert is 1/E the FFN size."""
+    if cfg.n_experts > 0:
+        from gke_ray_train_tpu.models.transformer import init_params
+        from gke_ray_train_tpu.ops.quant import quantize_params
+        from gke_ray_train_tpu.parallel.sharding import tree_shardings
+        if mesh is not None:
+            p_shard = tree_shardings(mesh, param_specs(cfg))
+            params = jax.jit(lambda k: init_params(cfg, k),
+                             out_shardings=p_shard)(key)
+        else:
+            params = init_params(cfg, key)
+        return quantize_params(params, kind=kind, group=group,
+                               targets=targets)
     pdt = jnp.dtype(cfg.param_dtype)
     hd = cfg.resolved_head_dim
     D, F, H, K, R = (cfg.d_model, cfg.d_ff, cfg.n_heads, cfg.n_kv_heads,

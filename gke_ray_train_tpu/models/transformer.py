@@ -72,6 +72,8 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
         return (jax.random.truncated_normal(next(keys), -3, 3, shape,
                                             jnp.float32) * std).astype(pdt)
 
+    E = cfg.n_experts
+
     def block_params():
         std = 0.02
         p = {
@@ -83,10 +85,18 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "wo": normal((R, H * hd, D), std * depth_scale),
             "mlp_norm": jnp.zeros((R, D), pdt) if cfg.norm_scale_plus_one
             else jnp.ones((R, D), pdt),
-            "w_gate": normal((R, D, F), std),
-            "w_up": normal((R, D, F), std),
-            "w_down": normal((R, F, D), std * depth_scale),
         }
+        if E:
+            # MoE MLP (ops/moe.py): router + expert bank, expert dim
+            # sharded over `model` (expert parallelism, SURVEY.md EP row)
+            p["router"] = normal((R, D, E), std)
+            p["w_gate"] = normal((R, E, D, F), std)
+            p["w_up"] = normal((R, E, D, F), std)
+            p["w_down"] = normal((R, E, F, D), std * depth_scale)
+        else:
+            p["w_gate"] = normal((R, D, F), std)
+            p["w_up"] = normal((R, D, F), std)
+            p["w_down"] = normal((R, F, D), std * depth_scale)
         if cfg.post_block_norm:
             zero_or_one = (jnp.zeros if cfg.norm_scale_plus_one else jnp.ones)
             p["attn_post_norm"] = zero_or_one((R, D), pdt)
@@ -121,10 +131,18 @@ def param_specs(cfg: ModelConfig) -> Params:
             "wv": P("pipe", "fsdp", "model"),
             "wo": P("pipe", "model", "fsdp"),
             "mlp_norm": P("pipe", None),
-            "w_gate": P("pipe", "fsdp", "model"),
-            "w_up": P("pipe", "fsdp", "model"),
-            "w_down": P("pipe", "model", "fsdp"),
         }
+        if cfg.n_experts:
+            # expert dim over `model` = EP; GSPMD derives the token
+            # all-to-alls from the dispatch einsums (ops/moe.py)
+            s["router"] = P("pipe", "fsdp", None)
+            s["w_gate"] = P("pipe", "model", "fsdp", None)
+            s["w_up"] = P("pipe", "model", "fsdp", None)
+            s["w_down"] = P("pipe", "model", None, "fsdp")
+        else:
+            s["w_gate"] = P("pipe", "fsdp", "model")
+            s["w_up"] = P("pipe", "fsdp", "model")
+            s["w_down"] = P("pipe", "model", "fsdp")
         if cfg.post_block_norm:
             s["attn_post_norm"] = P("pipe", None)
             s["mlp_post_norm"] = P("pipe", None)
@@ -255,7 +273,8 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lora_scale: float = 1.0,
             lora_dropout: float = 0.0,
             lora_rng: Optional[jax.Array] = None,
-            pipe_microbatches: Optional[int] = None) -> jnp.ndarray:
+            pipe_microbatches: Optional[int] = None,
+            with_aux: bool = False):
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``lora``: optional adapter pytree from train/lora.py (same block
@@ -268,6 +287,10 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
 
     ``pipe_microbatches``: pipeline microbatch count when the mesh has a
     ``pipe`` axis > 1 (models/pipeline.py); defaults to the stage count.
+
+    ``with_aux``: return ``(logits, {"router_aux": scalar})`` — the mean
+    per-layer Switch load-balance loss (MoE models; 0.0 for dense). The
+    train step requests it when cfg.n_experts > 0.
     """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -316,13 +339,20 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             raise NotImplementedError(
                 "LoRA dropout is not supported on a pipelined mesh; set "
                 "LORA_DROPOUT=0 or pipe=1")
+        if cfg.n_experts > 0:
+            raise NotImplementedError(
+                "MoE blocks are not supported on a pipelined mesh yet; "
+                "use fsdp/model/data axes (pipe=1) for expert models")
         from gke_ray_train_tpu.models.pipeline import pipeline_blocks
         x = pipeline_blocks(
             x, params["blocks"], cfg, mesh, impl=impl, dtype=dtype,
             rope=rope, positions=positions, segment_ids=segment_ids,
             lora_blocks=lora["blocks"] if lora is not None else None,
             lora_scale=lora_scale, n_microbatches=pipe_microbatches)
-        return _unembed(x, params, cfg, dtype, mesh)
+        logits = _unembed(x, params, cfg, dtype, mesh)
+        if with_aux:
+            return logits, {"router_aux": jnp.zeros((), jnp.float32)}
+        return logits
 
     # dense masks are shared by every layer of the same kind — build once.
     # Kernel impls (flash/ring) build masks blockwise in-kernel instead.
@@ -340,7 +370,10 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     if lora is not None and lora_rng is not None and lora_dropout > 0.0:
         drop_keys = jax.random.split(lora_rng, cfg.n_repeats)
 
-    def repeat_body(x, xs_slice):
+    moe = cfg.n_experts > 0
+
+    def repeat_body(carry, xs_slice):
+        x, aux = carry
         layer_slice = xs_slice[0]
         lora_slice = xs_slice[1] if lora is not None else None
         rep_rng = xs_slice[-1] if drop_keys is not None else None
@@ -361,14 +394,25 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             x = x + h
             x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
             h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            h = _mlp(h, lp, cfg, dtype, lora_p=lo, lora_scale=lora_scale,
-                     drop_rng=_drop_key(drng, 1), drop_rate=lora_dropout)
+            if moe:
+                # MoE MLP (ops/moe.py). LoRA adapts attention only on
+                # MoE models — there is no single delta-W an adapter
+                # pair could target across routed experts.
+                from gke_ray_train_tpu.ops.moe import moe_mlp
+                h, a = moe_mlp(h, lp["router"], lp["w_gate"], lp["w_up"],
+                               lp["w_down"], cfg, dtype)
+                aux = aux + a
+            else:
+                h = _mlp(h, lp, cfg, dtype, lora_p=lo,
+                         lora_scale=lora_scale,
+                         drop_rng=_drop_key(drng, 1),
+                         drop_rate=lora_dropout)
             if cfg.post_block_norm:
                 h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
                              scale_plus_one=sp1)
             x = x + h
             x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
-        return x, None
+        return (x, aux), None
 
     body = repeat_body
     if cfg.remat:
@@ -384,8 +428,13 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
         xs.append(lora["blocks"])
     if drop_keys is not None:
         xs.append(drop_keys)
-    x, _ = jax.lax.scan(body, x, tuple(xs))
-    return _unembed(x, params, cfg, dtype, mesh)
+    (x, aux_sum), _ = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)), tuple(xs))
+    logits = _unembed(x, params, cfg, dtype, mesh)
+    if with_aux:
+        return logits, {"router_aux": aux_sum / cfg.n_layers if moe
+                        else aux_sum}
+    return logits
 
 
 def _unembed(x, params: Params, cfg: ModelConfig, dtype, mesh):

@@ -62,17 +62,36 @@ def _target_shapes(cfg: ModelConfig) -> dict:
     }
 
 
+ATTN_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def _effective_targets(cfg: ModelConfig, lora_cfg: LoraConfig):
+    """MoE models adapt attention only: the routed expert bank has no
+    single delta-W an (A, B) pair could target (peft does the same for
+    Mixtral by default)."""
+    if cfg.n_experts > 0:
+        return tuple(t for t in lora_cfg.targets if t in ATTN_TARGETS)
+    return lora_cfg.targets
+
+
 def init_lora(cfg: ModelConfig, lora_cfg: LoraConfig, key: jax.Array) -> Params:
-    """A ~ N(0, 1/r) (kaiming-ish), B = 0 — adapters start as identity."""
-    pdt = jnp.dtype(cfg.param_dtype)
+    """A ~ N(0, 1/r) (kaiming-ish), B = 0 — adapters start as identity.
+
+    Adapters (and therefore their Adam moments) are ALWAYS fp32: they
+    are the only trained parameters, and bf16 masters silently drop
+    updates below ~value/256 (peft's prepare_model_for_kbit_training
+    keeps trainables fp32 for the same reason). The forward casts them
+    to the compute dtype at use (_proj)."""
+    pdt = jnp.dtype(jnp.float32)
     shapes = _target_shapes(cfg)
     R = cfg.n_repeats
+    targets = _effective_targets(cfg, lora_cfg)
     keys = iter(jax.random.split(key, len(cfg.block_pattern)
-                                 * len(lora_cfg.targets) + 1))
+                                 * len(targets) + 1))
 
     def block():
         out = {}
-        for t in lora_cfg.targets:
+        for t in targets:
             d_in, d_out = shapes[t]
             out[t] = {
                 "a": (jax.random.normal(next(keys), (R, d_in, lora_cfg.r),
@@ -99,36 +118,69 @@ def lora_specs(cfg: ModelConfig, lora_cfg: LoraConfig) -> Params:
         # (no-op while the pipe axis is size 1)
         return {t: {"a": P("pipe", in_spec[t], None),
                     "b": P("pipe", None, out_spec[t])}
-                for t in lora_cfg.targets}
+                for t in _effective_targets(cfg, lora_cfg)}
 
     return {"blocks": [block() for _ in cfg.block_pattern]}
 
 
-def merge_lora(params: Params, lora: Params, lora_cfg: LoraConfig) -> Params:
+def merge_lora(params: Params, lora: Params, lora_cfg: LoraConfig, *,
+               on_host: bool = False) -> Params:
     """W + (alpha/r) A@B for every adapted matrix — the equivalent of
     peft's merge_and_unload (reference fine_tune_llama_ray.py:349-353),
-    but a pure function on pytrees (jit/shard friendly)."""
+    but a pure function on pytrees (jit/shard friendly).
+
+    ``on_host``: run the merge on the CPU backend (leaves moved off the
+    accelerator first). Dequantizing an 8B NF4 base into a merged fp32
+    tree needs ~32 GB — far over one chip's HBM but trivial in host RAM;
+    the single-host export path uses this (the multi-host path keeps the
+    merge on device, where each host holds only its shard)."""
     # deferred import keeps ops.quant (and its pytree registration) out
     # of LoRA-only runs; the old train↔ops cycle is gone (PROJ_TARGETS
     # now lives in models.config)
     from gke_ray_train_tpu.ops.quant import (
-        dequantize, is_qtensor, maybe_dequantize)
+        QTensor, dequantize, is_qtensor, maybe_dequantize)
+
+    import contextlib
+
+    cpu = jax.devices("cpu")[0] if on_host else None
+    # jitted helpers (dequantize's NF4 lookup) dispatch to the DEFAULT
+    # device no matter where their operands live — without this the
+    # "host" merge math would still run (and OOM) on the accelerator
+    dev_ctx = (jax.default_device(cpu) if cpu is not None
+               else contextlib.nullcontext())
+
+    def pull(x):
+        if cpu is None:
+            return x
+        if is_qtensor(x):
+            return QTensor(jax.device_put(x.codes, cpu),
+                           jax.device_put(x.scales, cpu), x.kind, x.group)
+        return jax.device_put(x, cpu)
 
     merged = jax.tree.map(lambda x: x, params)  # shallow-ish copy
-    for p_blk, l_blk in zip(merged["blocks"], lora["blocks"]):
-        for t, ab in l_blk.items():
-            delta = jnp.einsum("lir,lro->lio", ab["a"].astype(jnp.float32),
-                               ab["b"].astype(jnp.float32)) * lora_cfg.scale
-            # QLoRA bases dequantize on merge — peft's merge_and_unload
-            # does the same before folding the adapters in
-            base = maybe_dequantize(p_blk[t], jnp.float32)
-            out_dtype = (jnp.float32 if is_qtensor(p_blk[t])
-                         else p_blk[t].dtype)
-            p_blk[t] = (base + delta).astype(out_dtype)
-        # quantized weights WITHOUT adapters (e.g. q/v-only LoRA) must
-        # still come back to full precision — the HF export consumes
-        # plain arrays only
-        for t, w in p_blk.items():
-            if is_qtensor(w):
-                p_blk[t] = dequantize(w, jnp.float32)
+    with dev_ctx:
+        for p_blk, l_blk in zip(merged["blocks"], lora["blocks"]):
+            for t, ab in l_blk.items():
+                delta = jnp.einsum("lir,lro->lio",
+                                   pull(ab["a"]).astype(jnp.float32),
+                                   pull(ab["b"]).astype(jnp.float32)) \
+                    * lora_cfg.scale
+                # QLoRA bases dequantize on merge — peft's
+                # merge_and_unload does the same before folding in
+                base = maybe_dequantize(pull(p_blk[t]), jnp.float32)
+                out_dtype = (jnp.float32 if is_qtensor(p_blk[t])
+                             else p_blk[t].dtype)
+                p_blk[t] = (base + delta).astype(out_dtype)
+            # quantized weights WITHOUT adapters (e.g. q/v-only LoRA)
+            # must still come back to full precision — the HF export
+            # consumes plain arrays only
+            for t, w in p_blk.items():
+                if is_qtensor(w):
+                    p_blk[t] = dequantize(pull(w), jnp.float32)
+    if cpu is not None:
+        # non-target leaves (embed/norms/lm_head) follow so the export
+        # reads a uniformly host-resident tree
+        merged = jax.tree.map(
+            lambda x: jax.device_put(x, cpu)
+            if not isinstance(x, (int, float)) else x, merged)
     return merged

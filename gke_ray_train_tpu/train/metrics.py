@@ -45,8 +45,12 @@ def train_flops_per_token(cfg: ModelConfig, seq_len: int, *,
 
     trainable="lora": the frozen base skips its weight-grad matmuls
     (4N instead of 6N; adapter FLOPs are negligible at r<<d) — using
-    the full-train count would overstate QLoRA MFU by ~1.5x."""
-    n = cfg.param_count()
+    the full-train count would overstate QLoRA MFU by ~1.5x.
+
+    MoE models bill ACTIVE params (router + top-k experts per token,
+    ModelConfig.active_param_count) — the total count would overstate
+    the FLOPs a routed token actually performs by ~E/k."""
+    n = cfg.active_param_count()
     dense = (4.0 if trainable == "lora" else 6.0) * n
     d_attn = cfg.n_heads * cfg.resolved_head_dim
     attn = 12 * cfg.n_layers * d_attn * seq_len * 0.5

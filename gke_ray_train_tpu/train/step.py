@@ -158,25 +158,33 @@ def make_train_step(cfg: ModelConfig,
     """
     lora_mode = lora_cfg is not None
     lora_dropout = lora_cfg.dropout if lora_mode else 0.0
+    moe = cfg.n_experts > 0
 
     def micro_loss(trainable: Params, frozen: Params, micro: Batch,
                    drop_rng=None):
         if lora_mode:
-            logits = forward(frozen, micro["inputs"], cfg,
-                             positions=micro.get("positions"),
-                             segment_ids=micro.get("segment_ids"),
-                             mesh=mesh, lora=trainable,
-                             lora_scale=lora_cfg.scale,
-                             lora_dropout=lora_dropout,
-                             lora_rng=drop_rng,
-                             pipe_microbatches=pipe_microbatches)
+            out = forward(frozen, micro["inputs"], cfg,
+                          positions=micro.get("positions"),
+                          segment_ids=micro.get("segment_ids"),
+                          mesh=mesh, lora=trainable,
+                          lora_scale=lora_cfg.scale,
+                          lora_dropout=lora_dropout,
+                          lora_rng=drop_rng,
+                          pipe_microbatches=pipe_microbatches,
+                          with_aux=moe)
         else:
-            logits = forward(trainable, micro["inputs"], cfg,
-                             positions=micro.get("positions"),
-                             segment_ids=micro.get("segment_ids"),
-                             mesh=mesh,
-                             pipe_microbatches=pipe_microbatches)
+            out = forward(trainable, micro["inputs"], cfg,
+                          positions=micro.get("positions"),
+                          segment_ids=micro.get("segment_ids"),
+                          mesh=mesh,
+                          pipe_microbatches=pipe_microbatches,
+                          with_aux=moe)
+        logits, aux = out if moe else (out, None)
         nll, w = token_nll(logits, micro["targets"], micro["weights"])
+        if moe:
+            # Switch load-balance term, billed per token so the final
+            # divide-by-total-weight recovers ce_mean + coef * aux_mean
+            nll = nll + cfg.router_aux_coef * aux["router_aux"] * w
         return nll, w
 
     def train_step(state: TrainState, batch: Batch):
