@@ -50,7 +50,8 @@ KNOWN_KEYS = frozenset({
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",
     # TPU / mesh extensions
-    "TRAIN_DTYPE", "ATTN_IMPL", "REMAT_POLICY", "MESH_DATA", "MESH_FSDP",
+    "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
+    "MESH_DATA", "MESH_FSDP",
     "MESH_MODEL", "MESH_CONTEXT", "MESH_PIPE", "PIPE_MICROBATCHES",
     "NUM_SLICES", "SMOKE_TEST",
     # profiling / debug (train/profiling.py)

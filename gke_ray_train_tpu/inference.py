@@ -133,7 +133,9 @@ def run_inference_comparison(
         row_filter: Optional[Callable[[Dict], bool]] = None,
         format_example: Callable = format_gretel_sql_example,
         mesh: Optional[Mesh] = None,
-        is_host0: bool = True) -> List[Dict]:
+        is_host0: bool = True,
+        tuned_lora: Optional[Params] = None,
+        lora_scale: float = 1.0) -> List[Dict]:
     """Returns the accumulated comparison records; writes JSON when
     ``output_path`` is given (reference behavior: filter on
     sql_complexity == 'window functions', :87-96; JSON dump :182-187).
@@ -141,6 +143,11 @@ def run_inference_comparison(
     COLLECTIVE once ``mesh`` is given and params are sharded: every host
     must call this with identical ``test_rows`` (see module docstring);
     ``is_host0`` gates only the log lines and the JSON write.
+
+    ``tuned_lora``: when given, the tuned model is ``tuned_params`` +
+    adapters applied at decode time — (Q)LoRA runs never materialize a
+    merged tree on device (an 8B NF4 base dequantized to a merged copy
+    does not fit one 16 GB chip).
     """
     if row_filter is not None:
         test_rows = [r for r in test_rows if row_filter(r)]
@@ -158,7 +165,8 @@ def run_inference_comparison(
                 max_new_tokens=max_new_tokens, mesh=mesh),
             "finetuned_model_answer": generate_answer(
                 tuned_params, cfg, tokenizer, prompt,
-                max_new_tokens=max_new_tokens, mesh=mesh),
+                max_new_tokens=max_new_tokens, mesh=mesh,
+                lora=tuned_lora, lora_scale=lora_scale),
         }
         if is_host0:
             logger.info("sample %d\n  Q: %s\n  base: %s\n  tuned: %s", i,

@@ -139,10 +139,17 @@ def _quantize_jit(w: jnp.ndarray, kind: str, group: int,
 
 
 def _nf4_lookup(codes: jnp.ndarray) -> jnp.ndarray:
-    """Codebook lookup as a flat select chain — a per-element gather from
-    a 16-entry table lowers to a catastrophically slow TPU gather
-    (measured 23x step slowdown); 15 VPU selects are ~free."""
+    """Codebook lookup. On TPU: a flat select chain — a per-element
+    gather from a 16-entry table lowers to a catastrophically slow TPU
+    gather (measured 23x step slowdown); 15 VPU selects are ~free. On
+    CPU (the host-merge export path): the select chain is the slow one
+    (15 full passes over an 8B-element tensor), a table take is one."""
     c = codes.astype(jnp.int32)
+    on_cpu_eager = (not isinstance(codes, jax.core.Tracer)
+                    and all(d.platform == "cpu"
+                            for d in codes.devices()))
+    if on_cpu_eager:
+        return jnp.asarray(NF4_CODEBOOK, jnp.float32)[c]
     out = jnp.full(c.shape, NF4_CODEBOOK[0], jnp.float32)
     for i in range(1, 16):
         out = jnp.where(c == i, NF4_CODEBOOK[i], out)

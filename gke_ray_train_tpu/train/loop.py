@@ -85,12 +85,25 @@ def run_training(state: TrainState,
 
     last_metrics = {}
     global_step = int(jax.device_get(state.step))
+    # resume fast-forward (HF Trainer resume_from_checkpoint semantics):
+    # batches the restored step counter already consumed are SKIPPED, not
+    # retrained — the epoch iterators are seeded by epoch index, so
+    # replaying them positions the data stream exactly where the
+    # checkpoint left off; a fully-trained checkpoint yields no new steps
+    to_skip = global_step
     try:
       for epoch in range(epochs):
         if meter is not None:
             meter.reset()
         m = None
+        ran_any = False
+        yielded = 0
         for batch in epoch_batches(epoch):
+            yielded += 1
+            if to_skip > 0:
+                to_skip -= 1
+                continue
+            ran_any = True
             if place_batch is not None:
                 batch = place_batch(batch)
             state, m = train_step(state, batch)
@@ -134,6 +147,16 @@ def run_training(state: TrainState,
 
         # end of epoch: checkpoint + report (collective; all hosts enter)
         if m is None:
+            if yielded > 0:
+                # every batch of this epoch was consumed before the
+                # restore point — nothing to retrain, nothing to re-save
+                if is_host0:
+                    logger.info("epoch %d already completed before "
+                                "resume point (step %d); skipping",
+                                epoch, global_step)
+                continue
+            # an iterator that yielded NOTHING is a data/config error on
+            # fresh AND resumed runs alike — never mask it as "resumed"
             raise ValueError(
                 f"epoch {epoch} produced 0 batches — the dataset is "
                 "smaller than one global batch (shrink GLOBAL_BATCH / "

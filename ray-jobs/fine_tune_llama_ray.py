@@ -88,15 +88,27 @@ def train_loop_per_worker(config: dict):
         tokenizer = ByteTokenizer()
 
     max_seq = int(config.get("MAX_SEQ_LENGTH", 1024))
+    use_lora = bool(config.get("USE_QLORA", False))
+    # frozen-base (Q)LoRA keeps unquantized leaves (embed/lm_head/norms)
+    # in the compute dtype — fp32 embeddings alone add ~4 GB at 8B dims
+    # and the base takes no optimizer update; full FT defaults to fp32
+    # master params (reference: bf16 base via BNB_4BIT_COMPUTE_DTYPE)
+    train_dtype = config.get("TRAIN_DTYPE", "bfloat16")
+    param_dtype = config.get("PARAM_DTYPE",
+                             train_dtype if use_lora else "float32")
     if smoke:
+        # smoke keeps its fp32-by-default dtypes (CPU numerics), but an
+        # explicit PARAM_DTYPE rehearses the flagship memory behavior
         cfg = tiny(vocab_size=max(getattr(tokenizer, "vocab_size", 260), 260),
                    max_seq_len=max_seq, dtype=config.get("TRAIN_DTYPE",
                                                          "float32"),
+                   param_dtype=config.get("PARAM_DTYPE", "float32"),
                    attn_impl=config.get("ATTN_IMPL", "auto"))
     else:
         cfg = preset_for_model_id(
             model_id,
-            dtype=config.get("TRAIN_DTYPE", "bfloat16"),
+            dtype=train_dtype,
+            param_dtype=param_dtype,
             attn_impl=config.get("ATTN_IMPL", "auto"),
             remat_policy=config.get("REMAT_POLICY", "full"))
 
@@ -125,7 +137,6 @@ def train_loop_per_worker(config: dict):
         ckpt_dir = acquire_pretrained(model_id, token=hf_token,
                                       num_hosts=n_hosts, host_id=host)
         have_local = ckpt_dir is not None
-    use_lora = bool(config.get("USE_QLORA", False))
     quant_kind = quant_kind_from_config(config, use_lora)
     load_quant = quant_kind if (use_lora and quant_kind != "none") else None
     already_quantized = False
@@ -323,7 +334,6 @@ def train_loop_per_worker(config: dict):
 
     # ---- save final artifacts (HF layout, §5.4) ----------------------
     if use_lora:
-        merged = merge_lora(state.params, state.lora, lora_cfg)
         final_dir = os.path.join(
             out_base, config.get("MERGED_MODEL_SUBDIR_NAME", "merged"))
     else:
@@ -331,9 +341,19 @@ def train_loop_per_worker(config: dict):
         final_dir = os.path.join(
             out_base, config.get("FULL_FT_MODEL_SUBDIR_NAME", "full"))
     if ctx.is_host0() and n_hosts == 1:
+        if use_lora:
+            # merge on the HOST: dequantizing an 8B NF4 base into a
+            # merged fp32 tree (~32 GB) OOMs a single 16 GB chip, and
+            # single-host means no other chip holds the rest
+            merged = merge_lora(state.params, state.lora, lora_cfg,
+                                on_host=True)
         save_hf_checkpoint(merged, cfg, final_dir)
         logger.info("saved final model to %s", final_dir)
     elif n_hosts > 1:
+        if use_lora:
+            # sharded across hosts: each device holds 1/N of the
+            # dequantized tree — the on-device merge fits by design
+            merged = merge_lora(state.params, state.lora, lora_cfg)
         # multi-host export path: orbax save (collective) + model-config
         # sidecar, then `python -m gke_ray_train_tpu.ckpt.convert
         # <dir>_orbax <dir>` offline (ckpt/convert.py). Block leaves are
@@ -365,24 +385,29 @@ def train_loop_per_worker(config: dict):
         # `have_local` (not a fresh os.path.exists) keeps the branch
         # choice collective — it was agreed across hosts at load time.
         if use_lora:
-            base_params = state.params
+            # tuned = frozen base + adapters applied at decode time — a
+            # merged copy of a quantized 8B base would not fit on-device
+            base_params = tuned_params = state.params
         elif have_local:
             base_params = load_hf_checkpoint(str(ckpt_dir), cfg, mesh=mesh)
+            tuned_params = merged
         else:
             if ctx.is_host0():
                 logger.warning(
                     "full-FT smoke without a pretrained checkpoint: "
                     "comparing tuned model against itself")
-            base_params = merged
+            base_params = tuned_params = merged
         run_inference_comparison(
-            base_params, merged, cfg, tokenizer, ds_test,
+            base_params, tuned_params, cfg, tokenizer, ds_test,
             num_samples=int(config.get("NUM_EVAL_SAMPLES_INFERENCE", 2)),
             max_new_tokens=int(
                 config.get("MAX_NEW_GENERATION_TOKENS_INFERENCE", 300)),
             output_path=os.path.join(out_base, "inference_comparison.json"),
             row_filter=(lambda r: r.get("sql_complexity")
                         == "window functions"),
-            mesh=mesh, is_host0=ctx.is_host0())
+            mesh=mesh, is_host0=ctx.is_host0(),
+            tuned_lora=state.lora if use_lora else None,
+            lora_scale=lora_cfg.scale if use_lora else 1.0)
     return metrics
 
 

@@ -143,6 +143,16 @@ def test_merge_lora_with_quantized_base():
     assert not is_qtensor(merged_q["blocks"][0]["wq"])
     np.testing.assert_allclose(wq_q, wq_fp, atol=2e-3)
 
+    # on_host merge (the single-host big-model export path): identical
+    # values, every leaf committed to a CPU device
+    merged_h = merge_lora(quantize_params(params, "int8"), lora, lora_cfg,
+                          on_host=True)
+    np.testing.assert_allclose(
+        np.asarray(merged_h["blocks"][0]["wq"], dtype=np.float32), wq_q,
+        atol=1e-6)
+    leaf = merged_h["blocks"][0]["wq"]
+    assert list(leaf.devices())[0].platform == "cpu"
+
 
 def test_quant_specs_and_sharding():
     from gke_ray_train_tpu.models import init_params, tiny

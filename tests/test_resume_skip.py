@@ -1,0 +1,101 @@
+"""Resume fast-forward semantics (train/loop.py).
+
+HF Trainer `resume_from_checkpoint` parity: a restored step counter
+skips the batches it already consumed instead of retraining them — a
+mid-epoch crash retrains only the remainder, and a fully-trained
+checkpoint yields zero new steps (observed r4: the flagship job resumed
+at its final step and trained a whole extra epoch).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gke_ray_train_tpu.ckpt import CheckpointManager
+from gke_ray_train_tpu.models import tiny
+from gke_ray_train_tpu.train import (
+    make_optimizer, make_train_state, make_train_step)
+from gke_ray_train_tpu.train.loop import run_training
+
+
+def _setup(tmp_path):
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step_fn = make_train_step(cfg, opt, donate=False)
+
+    def batches(epoch):
+        for i in range(4):
+            k = jax.random.key(epoch * 10 + i)
+            yield {
+                "inputs": jax.random.randint(k, (2, 8), 0, 64),
+                "targets": jax.random.randint(k, (2, 8), 0, 64),
+                "weights": jnp.ones((2, 8), jnp.float32),
+            }
+
+    return state, step_fn, batches
+
+
+def test_finished_checkpoint_resumes_to_zero_new_steps(tmp_path):
+    state, step_fn, batches = _setup(tmp_path)
+    d = str(tmp_path / "run")
+    mgr = CheckpointManager(d, async_save=False)
+    final, _ = run_training(state, step_fn, batches, epochs=1,
+                            ckpt_manager=mgr)
+    mgr.close()
+    assert int(final.step) == 4
+
+    state2, step_fn2, _ = _setup(tmp_path)
+    mgr2 = CheckpointManager(d, async_save=False)
+    final2, _ = run_training(state2, step_fn2, batches, epochs=1,
+                             ckpt_manager=mgr2)
+    mgr2.close()
+    assert int(final2.step) == 4, "fully-trained resume must not retrain"
+
+
+def test_midepoch_checkpoint_trains_only_remainder(tmp_path):
+    """Crash after step 2 of 4 (only the first half of the epoch ran,
+    mid-epoch checkpoint written) → the resumed run must skip exactly
+    the 2 consumed batches and train exactly the remaining 2: ending at
+    2 would mean it skipped everything, at 6 that it retrained."""
+    state, step_fn, batches = _setup(tmp_path)
+    d = str(tmp_path / "run")
+    mgr = CheckpointManager(d, max_to_keep=1, async_save=False,
+                            score_attribute=None)
+
+    def first_half(epoch):
+        import itertools
+        yield from itertools.islice(batches(epoch), 2)
+
+    run_training(state, step_fn, first_half, epochs=1, ckpt_manager=mgr,
+                 ckpt_every=2)
+    mgr.close()
+
+    state2, step_fn2, _ = _setup(tmp_path)
+    mgr2 = CheckpointManager(d, max_to_keep=1, async_save=False,
+                             score_attribute=None)
+    final2, _ = run_training(state2, step_fn2, batches, epochs=1,
+                             ckpt_manager=mgr2)
+    mgr2.close()
+    assert int(final2.step) == 4
+
+
+def test_resumed_run_with_empty_epoch_still_raises(tmp_path):
+    """The zero-batches data/config error must NOT be masked by the
+    resume fast-forward (r4 review finding)."""
+    import pytest
+
+    state, step_fn, batches = _setup(tmp_path)
+    d = str(tmp_path / "run")
+    mgr = CheckpointManager(d, async_save=False)
+    run_training(state, step_fn, batches, epochs=1, ckpt_manager=mgr)
+    mgr.close()
+
+    state2, step_fn2, _ = _setup(tmp_path)
+    mgr2 = CheckpointManager(d, async_save=False)
+    with pytest.raises(ValueError, match="0 batches"):
+        run_training(state2, step_fn2, lambda e: iter(()), epochs=1,
+                     ckpt_manager=mgr2)
+    mgr2.close()
