@@ -67,8 +67,8 @@ def convert(orbax_dir: str, out_dir: str, *, step: int = None,
     import numpy as np
 
     from gke_ray_train_tpu.ckpt.hf_io import (
-        ShardedSafetensorsWriter, _hf_layer_names, _maybe_t, hf_dtype_np,
-        write_hf_config)
+        ShardedSafetensorsWriter, _EXPERT_KEYS, _hf_expert_names,
+        _hf_layer_names, _maybe_t, hf_dtype_np, write_hf_config)
     from gke_ray_train_tpu.ckpt.manager import CheckpointManager
     from gke_ray_train_tpu.models.config import ModelConfig
 
@@ -115,14 +115,22 @@ def convert(orbax_dir: str, out_dir: str, *, step: int = None,
                 w.add("lm_head.weight", hf_dtype_np(arr.T, dtype))
             elif parts[0] == "blocks":
                 p, key = parts[1], parts[2]
+                moe_bank = cfg.n_experts > 0 and key in _EXPERT_KEYS
+
+                def emit(layer, a):
+                    if moe_bank:  # a: [E, d_in, d_out] → per-expert names
+                        for e in range(cfg.n_experts):
+                            w.add(_hf_expert_names(layer, e)[key],
+                                  hf_dtype_np(_maybe_t(a[e], key), dtype))
+                    else:
+                        w.add(_hf_layer_names(cfg, layer)[key],
+                              hf_dtype_np(_maybe_t(a, key), dtype))
+
                 if len(parts) == 4:   # per-layer export layout
-                    r = parts[3]
-                    w.add(_hf_layer_names(cfg, r * P_ + p)[key],
-                          hf_dtype_np(_maybe_t(arr, key), dtype))
+                    emit(parts[3] * P_ + p, arr)
                 else:                 # legacy stacked [R, ...] leaf
                     for r in range(arr.shape[0]):
-                        w.add(_hf_layer_names(cfg, r * P_ + p)[key],
-                              hf_dtype_np(_maybe_t(arr[r], key), dtype))
+                        emit(r * P_ + p, arr[r])
             else:
                 raise ValueError(
                     f"unexpected leaf path {parts} in {orbax_dir}")

@@ -34,9 +34,24 @@ from jax.experimental.pallas import tpu as pltpu
 from gke_ray_train_tpu.ops.attention import NEG_INF
 
 # tuned on v5e (8x2048x16h/8kv/128dh bf16 fwd+bwd sweep: 13.1 ms vs
-# 18.6 @ 256/512, 32.4 for the XLA dense-mask path)
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_KV = 1024
+# 18.6 @ 256/512, 32.4 for the XLA dense-mask path); env overrides for
+# per-topology A/B without code edits (numeric values re-validated by
+# pick_block at every call site; empty = unset, junk fails by name)
+import os as _os
+
+
+def _block_env(name: str, default: int) -> int:
+    raw = _os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
+DEFAULT_BLOCK_Q = _block_env("FLASH_BLOCK_Q", 256)
+DEFAULT_BLOCK_KV = _block_env("FLASH_BLOCK_KV", 1024)
 
 
 def _block_mask(q_pos, kv_pos, q_seg, kv_seg, causal, window):

@@ -96,14 +96,19 @@ def run_training(state: TrainState,
         if meter is not None:
             meter.reset()
         m = None
-        ran_any = False
         yielded = 0
+        trained_this_epoch = 0
         for batch in epoch_batches(epoch):
             yielded += 1
             if to_skip > 0:
                 to_skip -= 1
                 continue
-            ran_any = True
+            if trained_this_epoch == 0 and meter is not None:
+                # fast-forwarding consumed batches costs wall clock
+                # (tokenize/pack) that must not deflate the tokens/sec
+                # window of the steps actually trained
+                meter.reset()
+            trained_this_epoch += 1
             if place_batch is not None:
                 batch = place_batch(batch)
             state, m = train_step(state, batch)

@@ -368,6 +368,10 @@ def train_loop_per_worker(config: dict):
         export_mgr.wait()
         if ctx.is_host0():
             write_sidecar(cfg, final_dir + "_orbax")
+    if use_lora:
+        # LoRA-mode inference below uses base + adapters, never the
+        # merged tree — release it (the 8B host merge holds ~32 GB)
+        merged = None
 
     # ---- optional inference comparison (§3.4) ------------------------
     # COLLECTIVE: every host enters the comparison — the params are

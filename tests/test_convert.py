@@ -55,3 +55,32 @@ def test_convert_missing_sidecar_message(tmp_path):
     import pytest
     with pytest.raises(FileNotFoundError, match="model_config.json"):
         convert(str(tmp_path / "nope"), str(tmp_path / "out"))
+
+
+def test_convert_roundtrip_moe(tmp_path):
+    """MoE checkpoint (expert-bank leaves, unstacked per-layer export
+    layout): convert writes Mixtral expert names and load reproduces the
+    forward."""
+    from gke_ray_train_tpu.ckpt.convert import unstack_for_export
+    from gke_ray_train_tpu.models.config import ModelConfig
+
+    cfg = ModelConfig(name="moe-conv", vocab_size=64, d_model=32,
+                      n_layers=2, n_heads=4, n_kv_heads=2, d_ff=64,
+                      n_experts=2, expert_top_k=1, dtype="float32",
+                      param_dtype="float32", attn_impl="xla", remat=False)
+    params = init_params(cfg, jax.random.key(3))
+    orbax_dir = str(tmp_path / "moe_orbax")
+    mgr = CheckpointManager(orbax_dir, score_attribute=None,
+                            async_save=False)
+    mgr.save(3, unstack_for_export(params), force=True)
+    mgr.wait()
+    mgr.close()
+    write_sidecar(cfg, orbax_dir)
+
+    out_dir = str(tmp_path / "moe_hf")
+    convert(orbax_dir, out_dir, dtype="float32")
+    loaded = load_hf_checkpoint(out_dir, cfg)
+    tokens = jax.random.randint(jax.random.key(4), (2, 8), 0, 64)
+    np.testing.assert_allclose(
+        np.asarray(forward(loaded, tokens, cfg)),
+        np.asarray(forward(params, tokens, cfg)), rtol=1e-5, atol=1e-5)
