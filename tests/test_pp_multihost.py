@@ -1,0 +1,41 @@
+"""Two-process pipeline-parallel fine-tune smoke.
+
+The fake-device dryrun proves the PP math compiles and runs; this test
+proves it under REAL multi-process SPMD (jax.distributed over 2 CPU
+processes x 4 fake devices): the stage-shift collective-permute and the
+stage-sharded param placement cross a process boundary, which no
+single-process test reaches.
+"""
+
+import pytest
+
+from tests._multihost import run_entry_multiprocess
+
+
+@pytest.mark.slow
+def test_pipeline_fine_tune_two_processes(tmp_path):
+    out_base = str(tmp_path / "run")
+    config = {
+        "SMOKE_TEST": True,
+        "MODEL_ID": "offline/none",          # -> ByteTokenizer
+        "DATASET_NAME": "offline/none",      # -> synthetic rows
+        "MAX_SEQ_LENGTH": 512,
+        "NUM_TRAIN_SAMPLES": 16,
+        "NUM_EVAL_SAMPLES": 8,
+        "PER_DEVICE_TRAIN_BATCH_SIZE": 2,
+        "GRADIENT_ACCUMULATION_STEPS": 1,
+        "NUM_TRAIN_EPOCHS": 1,
+        # tiny() has n_layers=2 == n_repeats 2 -> 2 pipeline stages;
+        # mesh 2 data x 2 fsdp x 2 pipe over 2 procs x 4 devices
+        "MESH_DATA": 2,
+        "MESH_FSDP": 2,
+        "MESH_PIPE": 2,
+        "PIPE_MICROBATCHES": 2,
+        "SAVE_STRATEGY": "no",
+        "EVALUATION_STRATEGY_SFT": "epoch",
+        "LOGGING_STEPS": 1,
+        "REPORT_TO": "none",
+        "OUTPUT_DIR_BASE": out_base,
+        "INFERENCE": False,
+    }
+    run_entry_multiprocess("fine_tune_llama_ray.py", config)
