@@ -133,6 +133,22 @@ def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
     return _proj_p(out, lp["wo"], lr("wo"), lora_scale, dtype)
 
 
+def _moe_p(x, lp, cfg: ModelConfig, dtype):
+    """Stage-batched MoE MLP: vmap the plain moe_mlp over the stage dim
+    (each stage owns different expert weights). Returns (y [P,Bm,S,D],
+    per-stage aux [P]). Dispatch capacity is per sequence row, so the
+    routing inside one microbatch is IDENTICAL to the unpipelined layer;
+    only the aux statistic becomes a mean over (stage, microbatch)
+    submeans instead of one joint batch mean."""
+    from gke_ray_train_tpu.ops.moe import moe_mlp
+
+    def one_stage(xs, router, w_gate, w_up, w_down):
+        return moe_mlp(xs, router, w_gate, w_up, w_down, cfg, dtype)
+
+    return jax.vmap(one_stage)(x, lp["router"], lp["w_gate"],
+                               lp["w_up"], lp["w_down"])
+
+
 def _mlp_p(x, lp, cfg: ModelConfig, dtype, lora_p, lora_scale):
     def lr(name):
         return _lora_entry(lora_p, name)
@@ -167,7 +183,11 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
                 sliding_window=(cfg.sliding_window if kind == "sliding"
                                 else None))
 
-    def body(x, xs_slice):
+    moe = cfg.n_experts > 0
+    Pn_ = x.shape[0]
+
+    def body(carry, xs_slice):
+        x, aux = carry
         layer_slice = xs_slice[0]
         lora_slice = xs_slice[1] if lora_r is not None else None
         for p_i, kind in enumerate(cfg.block_pattern):
@@ -182,12 +202,16 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
             x = x + h
             x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
             h = _norm_p(x, lp["mlp_norm"], eps, sp1)
-            h = _mlp_p(h, lp, cfg, dtype, lo, lora_scale)
+            if moe:
+                h, a = _moe_p(h, lp, cfg, dtype)
+                aux = aux + a
+            else:
+                h = _mlp_p(h, lp, cfg, dtype, lo, lora_scale)
             if cfg.post_block_norm:
                 h = _norm_p(h, lp["mlp_post_norm"], eps, sp1)
             x = x + h
             x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
-        return x, None
+        return (x, aux), None
 
     if cfg.remat:
         policy = None
@@ -197,8 +221,9 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
     xs = [blocks_r]
     if lora_r is not None:
         xs.append(lora_r)
-    x, _ = jax.lax.scan(body, x, tuple(xs))
-    return x
+    (x, aux), _ = jax.lax.scan(
+        body, (x, jnp.zeros((Pn_,), jnp.float32)), tuple(xs))
+    return x, aux
 
 
 def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
@@ -208,8 +233,9 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     """Run the stacked decoder blocks pipelined over the ``pipe`` axis.
 
     x: embedded activations [B, S, D] (batch sharded over (data, fsdp),
-    replicated over pipe). Returns the block-stack output [B, S, D] with
-    the same layout (final norm/unembed run replicated, outside).
+    replicated over pipe). Returns ``(y, aux)``: the block-stack output
+    [B, S, D] with the same layout (final norm/unembed run replicated,
+    outside) and the summed-over-layers MoE router aux (0.0 for dense).
     """
     Pn = int(mesh.shape[AXIS_PIPE])
     R = cfg.n_repeats
@@ -272,7 +298,7 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
                      None, BATCH_AXES, None, None)
 
     def tick(carry, t):
-        buf, pbuf, sbuf, out = carry
+        buf, pbuf, sbuf, out, aux = carry
         t_in = jnp.minimum(t, M - 1)
         # shift: stage p receives stage p-1's activation (one-hop
         # collective-permute on the pipe ring), stage 0 gets microbatch t
@@ -283,16 +309,25 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
         sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(
             jax.lax.dynamic_index_in_dim(sm, t_in, 0, keepdims=False))
         buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, None, None)
-        buf = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r, cfg, impl,
-                             dtype, rope, mesh, lora_scale)
+        buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r,
+                                      cfg, impl, dtype, rope, mesh,
+                                      lora_scale)
+        # MoE router aux: stage p holds microbatch t-p this tick —
+        # warmup/drain passes over garbage slots must not contribute
+        mb = t - jnp.arange(Pn)
+        aux = aux + jnp.sum(aux_vec * ((mb >= 0) & (mb < M)))
         # harvest the last stage. Warmup ticks (t < Pn-1) write garbage
         # to slot (t+M-Pn+1) mod M — that slot's real value arrives at
         # tick slot+Pn-1 > t, overwriting it before the scan ends.
         slot = jax.lax.rem(t + (M - Pn + 1), M)
         out = jax.lax.dynamic_update_index_in_dim(out, buf[Pn - 1], slot, 0)
-        return (buf, pbuf, sbuf, out), None
+        return (buf, pbuf, sbuf, out, aux), None
 
     T = M + Pn - 1
-    (_, _, _, out), _ = jax.lax.scan(
-        tick, (buf, pbuf, sbuf, out), jnp.arange(T))
-    return out.reshape(B, S, D)
+    (_, _, _, out, aux), _ = jax.lax.scan(
+        tick, (buf, pbuf, sbuf, out, jnp.zeros((), jnp.float32)),
+        jnp.arange(T))
+    # aux summed over (every layer) x (every microbatch): /M leaves the
+    # same sum-over-layers scale the plain path returns (forward then
+    # divides by n_layers)
+    return out.reshape(B, S, D), aux / M

@@ -339,19 +339,15 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             raise NotImplementedError(
                 "LoRA dropout is not supported on a pipelined mesh; set "
                 "LORA_DROPOUT=0 or pipe=1")
-        if cfg.n_experts > 0:
-            raise NotImplementedError(
-                "MoE blocks are not supported on a pipelined mesh yet; "
-                "use fsdp/model/data axes (pipe=1) for expert models")
         from gke_ray_train_tpu.models.pipeline import pipeline_blocks
-        x = pipeline_blocks(
+        x, pipe_aux = pipeline_blocks(
             x, params["blocks"], cfg, mesh, impl=impl, dtype=dtype,
             rope=rope, positions=positions, segment_ids=segment_ids,
             lora_blocks=lora["blocks"] if lora is not None else None,
             lora_scale=lora_scale, n_microbatches=pipe_microbatches)
         logits = _unembed(x, params, cfg, dtype, mesh)
         if with_aux:
-            return logits, {"router_aux": jnp.zeros((), jnp.float32)}
+            return logits, {"router_aux": pipe_aux / cfg.n_layers}
         return logits
 
     # dense masks are shared by every layer of the same kind — build once.

@@ -265,11 +265,73 @@ def test_moe_hf_roundtrip(tmp_path):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_moe_pipeline_gate():
+def test_moe_pipeline_forward_matches_plain():
+    """MoE blocks through the pipelined path (vmapped stage dim):
+    logits are EXACT vs the plain path — dispatch capacity is per
+    sequence row, so routing within a microbatch is unchanged."""
     cfg = moe_cfg()
     params = init_params(cfg, jax.random.key(0))
     mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=1, context=1,
                                  pipe=2))
-    tokens = jnp.zeros((8, 16), jnp.int32)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        forward(params, tokens, cfg, mesh=mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(13).integers(0, cfg.vocab_size, (8, 16)),
+        jnp.int32)
+    ref = forward(params, tokens, cfg)
+    sharded = shard_tree(params, mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_pipeline_train_step():
+    """PP x MoE train step: finite loss, router updated, and the aux
+    term excludes warmup/drain garbage passes (it stays in the same
+    ballpark as the plain path's aux)."""
+    cfg = moe_cfg(remat=True)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=1, context=1,
+                                 pipe=2))
+    schedule = (lambda step: 1e-2)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule,
+                           donate=False, pipe_microbatches=2)
+    rng = np.random.default_rng(14)
+    batch = {
+        "inputs": jnp.asarray(rng.integers(0, 128, (8, 32)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, 128, (8, 32)), jnp.int32),
+        "weights": jnp.ones((8, 32), jnp.float32),
+    }
+    state2, m = step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+    r0 = np.asarray(state.params["blocks"][0]["router"])
+    r1 = np.asarray(state2.params["blocks"][0]["router"])
+    assert not np.allclose(r0, r1)
+
+    # the pipelined aux itself: warmup/drain masking + /M /n_layers
+    # scaling must land near the plain path's joint-batch statistic
+    # (mean-of-microbatch-means vs joint mean differ only by the
+    # cross-microbatch covariance)
+    from gke_ray_train_tpu.models.transformer import forward as fwd
+    _, aux_pp = jax.jit(
+        lambda p, t: fwd(p, t, cfg, mesh=mesh, with_aux=True))(
+        state.params, batch["inputs"])
+    _, aux_plain = fwd(jax.device_get(state.params), batch["inputs"],
+                       cfg, with_aux=True)
+    np.testing.assert_allclose(float(aux_pp["router_aux"]),
+                               float(aux_plain["router_aux"]), rtol=1e-2)
+
+    # plain-mesh reference loss with aux_coef=0 must match the PP loss
+    # with aux_coef=0 exactly (logits identical; only aux may differ)
+    cfg0 = dataclasses.replace(cfg, router_aux_coef=0.0)
+    plain = build_mesh(MeshConfig(data=2, fsdp=4, model=1, context=1))
+    s_ref = make_train_state(cfg0, opt, jax.random.key(0), mesh=plain)
+    st_ref = make_train_step(cfg0, opt, mesh=plain, schedule=schedule,
+                             donate=False)
+    _, m_ref = st_ref(s_ref, batch)
+    s_pp = make_train_state(cfg0, opt, jax.random.key(0), mesh=mesh)
+    st_pp = make_train_step(cfg0, opt, mesh=mesh, schedule=schedule,
+                            donate=False, pipe_microbatches=2)
+    _, m_pp = st_pp(s_pp, batch)
+    np.testing.assert_allclose(float(m_pp["loss"]), float(m_ref["loss"]),
+                               rtol=1e-5)
