@@ -33,14 +33,19 @@ own shard_map over explicit batch specs that a stage-folded batch dim
 does not match; pipelined meshes must keep ``context=1``.
 
 Correctness notes:
-- During warmup/drain ticks stages process zero buffers; their outputs
-  land in ``out`` slots that a later tick overwrites with the real
-  value (mod-M slot arithmetic below), so no masking is needed and the
-  garbage writes get zero cotangent in the backward pass.
+- Warmup ticks process zero buffers and drain ticks replay the last
+  microbatch; microbatch m surfaces from the last stage at tick
+  m + P - 1, so the harvest is simply the last M scan outputs
+  (``ys[P-1:]``) — garbage emissions fall outside the window and get
+  zero cotangent in the backward pass. The one thing that DOES need
+  masking is the MoE router aux, which would otherwise count the
+  garbage passes (see the validity mask in the tick body).
 - LoRA adapters ride along as stage-batched einsums (QLoRA bases
   dequantize per stage-slice); LoRA *dropout* is not supported on a
   pipelined mesh — the per-repeat rng fold-in would need a per-stage
   tick-varying key schedule for exactness.
+- MoE MLPs route per stage via a vmapped moe_mlp; dispatch capacity is
+  per sequence row, so pipelined logits are exact vs the plain path.
 """
 
 from __future__ import annotations
@@ -184,7 +189,6 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
                                 else None))
 
     moe = cfg.n_experts > 0
-    Pn_ = x.shape[0]
 
     def body(carry, xs_slice):
         x, aux = carry
@@ -222,7 +226,7 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
     if lora_r is not None:
         xs.append(lora_r)
     (x, aux), _ = jax.lax.scan(
-        body, (x, jnp.zeros((Pn_,), jnp.float32)), tuple(xs))
+        body, (x, jnp.zeros((Pn,), jnp.float32)), tuple(xs))
     return x, aux
 
 
@@ -285,29 +289,35 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     if segment_ids is None:
         segment_ids = jnp.ones((B, S), jnp.int32)
 
-    xm = _constrain(x.reshape(M, Bm, S, D), mesh,
+    # microbatch streams ride the tick scan as xs (static per-iteration
+    # slices — a traced dynamic_index over the microbatch dim forces the
+    # SPMD partitioner into full rematerialization on reshard); drain
+    # ticks replay the last microbatch into stage 0 and their outputs
+    # are dropped by the static ys window below
+    T = M + Pn - 1
+
+    def pad_drain(a):
+        return jnp.concatenate(
+            [a, jnp.broadcast_to(a[-1:], (Pn - 1,) + a.shape[1:])])
+
+    xm = _constrain(pad_drain(x.reshape(M, Bm, S, D)), mesh,
                     None, BATCH_AXES, None, None)
-    pm = positions.reshape(M, Bm, S)
-    sm = segment_ids.reshape(M, Bm, S)
+    pm = pad_drain(positions.reshape(M, Bm, S))
+    sm = pad_drain(segment_ids.reshape(M, Bm, S))
 
     buf = _constrain(jnp.zeros((Pn, Bm, S, D), x.dtype), mesh,
                      AXIS_PIPE, BATCH_AXES, None, None)
     pbuf = jnp.zeros((Pn, Bm, S), pm.dtype)
     sbuf = jnp.ones((Pn, Bm, S), sm.dtype)
-    out = _constrain(jnp.zeros((M, Bm, S, D), x.dtype), mesh,
-                     None, BATCH_AXES, None, None)
 
-    def tick(carry, t):
-        buf, pbuf, sbuf, out, aux = carry
-        t_in = jnp.minimum(t, M - 1)
+    def tick(carry, xs_t):
+        buf, pbuf, sbuf, aux = carry
+        x_in, p_in, s_in, t = xs_t
         # shift: stage p receives stage p-1's activation (one-hop
         # collective-permute on the pipe ring), stage 0 gets microbatch t
-        buf = jnp.roll(buf, 1, axis=0).at[0].set(
-            jax.lax.dynamic_index_in_dim(xm, t_in, 0, keepdims=False))
-        pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(
-            jax.lax.dynamic_index_in_dim(pm, t_in, 0, keepdims=False))
-        sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(
-            jax.lax.dynamic_index_in_dim(sm, t_in, 0, keepdims=False))
+        buf = jnp.roll(buf, 1, axis=0).at[0].set(x_in)
+        pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(p_in)
+        sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(s_in)
         buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, None, None)
         buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r,
                                       cfg, impl, dtype, rope, mesh,
@@ -316,17 +326,14 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
         # warmup/drain passes over garbage slots must not contribute
         mb = t - jnp.arange(Pn)
         aux = aux + jnp.sum(aux_vec * ((mb >= 0) & (mb < M)))
-        # harvest the last stage. Warmup ticks (t < Pn-1) write garbage
-        # to slot (t+M-Pn+1) mod M — that slot's real value arrives at
-        # tick slot+Pn-1 > t, overwriting it before the scan ends.
-        slot = jax.lax.rem(t + (M - Pn + 1), M)
-        out = jax.lax.dynamic_update_index_in_dim(out, buf[Pn - 1], slot, 0)
-        return (buf, pbuf, sbuf, out, aux), None
+        # emit the last stage's slot; microbatch m surfaces at tick
+        # m + Pn-1, so ys[Pn-1:] is exactly [0..M) in order
+        return (buf, pbuf, sbuf, aux), buf[Pn - 1]
 
-    T = M + Pn - 1
-    (_, _, _, out, aux), _ = jax.lax.scan(
-        tick, (buf, pbuf, sbuf, out, jnp.zeros((), jnp.float32)),
-        jnp.arange(T))
+    (_, _, _, aux), ys = jax.lax.scan(
+        tick, (buf, pbuf, sbuf, jnp.zeros((), jnp.float32)),
+        (xm, pm, sm, jnp.arange(T)))
+    out = ys[Pn - 1:]
     # aux summed over (every layer) x (every microbatch): /M leaves the
     # same sum-over-layers scale the plain path returns (forward then
     # divides by n_layers)
