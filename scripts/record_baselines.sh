@@ -30,6 +30,7 @@ run qlora8b        env BENCH_MODE=qlora8b python bench.py
 run mistral7b-lora env BENCH_MODE=mistral7b-lora python bench.py
 run gemma2-4k      env BENCH_MODE=gemma2-4k python bench.py
 run seq4k          env BENCH_MODE=seq4k python bench.py
+run moe            env BENCH_MODE=moe python bench.py
 run decode         env BENCH_MODE=decode python bench.py
 
 echo "records in $OUT"
