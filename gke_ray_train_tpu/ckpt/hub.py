@@ -18,7 +18,6 @@ every rank calling from_pretrained in the reference).
 from __future__ import annotations
 
 import logging
-import os
 from typing import Optional
 
 logger = logging.getLogger(__name__)

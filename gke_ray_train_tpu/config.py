@@ -15,7 +15,6 @@ something the TPU quantizer does differently.
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 import optax
 

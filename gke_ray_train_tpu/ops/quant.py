@@ -23,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from functools import partial
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

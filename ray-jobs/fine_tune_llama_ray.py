@@ -35,14 +35,13 @@ def train_loop_per_worker(config: dict):
     """Runs on every TPU host (same shape as the reference's worker fn,
     fine_tune_llama_ray.py:198)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from gke_ray_train_tpu.ckpt import (
         CheckpointManager, load_hf_checkpoint, save_hf_checkpoint)
     from gke_ray_train_tpu.data import (
         ByteTokenizer, downsample, load_hf_tokenizer, pad_sft_rows,
-        batch_packed, pack_examples, sft_epoch_batches, synthetic_sql_rows,
+        pack_examples, sft_epoch_batches, synthetic_sql_rows,
         tokenize_sft_example, format_gretel_sql_example)
     from gke_ray_train_tpu.models import (
         init_params, param_specs, preset_for_model_id, tiny)

@@ -1,7 +1,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from gke_ray_train_tpu.ckpt import (
     CheckpointManager, load_hf_checkpoint, save_hf_checkpoint)

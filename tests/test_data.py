@@ -1,5 +1,4 @@
 import numpy as np
-import pytest
 
 from gke_ray_train_tpu.data import (
     CharTokenizer, ShardedBatches, SlidingWindowDataset, batch_packed,

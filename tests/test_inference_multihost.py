@@ -20,7 +20,6 @@ import json
 import os
 
 import jax
-import numpy as np
 import pytest
 
 from gke_ray_train_tpu.data import ByteTokenizer, synthetic_sql_rows

@@ -18,7 +18,6 @@ import os
 
 import jax
 import numpy as np
-import pytest
 
 from gke_ray_train_tpu.ckpt import (
     CheckpointManager, load_hf_checkpoint, save_hf_checkpoint)

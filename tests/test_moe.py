@@ -14,7 +14,6 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from gke_ray_train_tpu.models import init_params, mixtral_8x7b
 from gke_ray_train_tpu.models.config import ModelConfig

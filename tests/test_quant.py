@@ -1,6 +1,5 @@
 """Weight quantization (ops/quant.py) — NF4/int8 QLoRA parity (D5)."""
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp

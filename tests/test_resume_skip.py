@@ -9,7 +9,6 @@ at its final step and trained a whole extra epoch).
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from gke_ray_train_tpu.ckpt import CheckpointManager
 from gke_ray_train_tpu.models import tiny
