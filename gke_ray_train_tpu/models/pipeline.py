@@ -25,12 +25,12 @@ pipelining recipe):
   reverse permutes, so the backward pass is the mirrored pipeline with
   no hand-written schedule.
 
-Composability: the batch dim stays sharded over ``(data, fsdp)`` and
-head/ffn dims over ``model`` *inside* the pipeline (the stage dim is
-just one more array axis to GSPMD), so PP composes with DP/FSDP/TP.
-``context`` sharding is the one exclusion — ring/a2a attention do their
-own shard_map over explicit batch specs that a stage-folded batch dim
-does not match; pipelined meshes must keep ``context=1``.
+Composability: the batch dim stays sharded over ``(data, fsdp)``,
+head/ffn dims over ``model``, and the sequence dim over ``context``
+*inside* the pipeline (the stage dim is just one more array axis to
+GSPMD), so PP composes with DP/FSDP/TP/CP — ring/a2a attention take the
+stage-folded ``(pipe, data, fsdp)`` batch spec through the dispatch's
+``batch_axes`` hook, and EP rides in via the MoE expert sharding.
 
 Correctness notes:
 - Warmup ticks process zero buffers and drain ticks replay the last
@@ -101,7 +101,7 @@ def _lora_entry(lora_p, name):
 
 
 def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
-            window, mesh, lora_p, lora_scale):
+            window, mesh, lora_p, lora_scale, seq_ax=None):
     """posf/segf: stage-folded [Pn*Bm, S]; mask: prebuilt dense mask for
     this block kind (xla impl) or None (kernel impls build blockwise)."""
     Pn, Bm, S, D = x.shape
@@ -118,8 +118,8 @@ def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
     q = q.reshape(Pn * Bm, S, H, hd)
     k = k.reshape(Pn * Bm, S, K, hd)
     v = v.reshape(Pn * Bm, S, K, hd)
-    q = _constrain(q, mesh, STAGE_BATCH_AXES, None, "model", None)
-    k = _constrain(k, mesh, STAGE_BATCH_AXES, None, "model", None)
+    q = _constrain(q, mesh, STAGE_BATCH_AXES, seq_ax, "model", None)
+    k = _constrain(k, mesh, STAGE_BATCH_AXES, seq_ax, "model", None)
     if rope is not None:
         q = apply_rope(q, posf, rope)
         k = apply_rope(k, posf, rope)
@@ -169,7 +169,7 @@ def _mlp_p(x, lp, cfg: ModelConfig, dtype, lora_p, lora_scale):
 
 
 def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
-                   dtype, rope, mesh, lora_scale):
+                   dtype, rope, mesh, lora_scale, seq_ax=None):
     """Apply each stage's R/P local repeats to its buffer slot.
 
     Mirrors transformer.repeat_body, stage-batched; scanned over the
@@ -200,11 +200,12 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
             window = cfg.sliding_window if kind == "sliding" else None
             h = _norm_p(x, lp["attn_norm"], eps, sp1)
             h = _attn_p(h, lp, cfg, impl, dtype, rope, posf, segf,
-                        masks[kind], window, mesh, lo, lora_scale)
+                        masks[kind], window, mesh, lo, lora_scale,
+                        seq_ax)
             if cfg.post_block_norm:
                 h = _norm_p(h, lp["attn_post_norm"], eps, sp1)
             x = x + h
-            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
             h = _norm_p(x, lp["mlp_norm"], eps, sp1)
             if moe:
                 h, a = _moe_p(h, lp, cfg, dtype)
@@ -214,7 +215,7 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
             if cfg.post_block_norm:
                 h = _norm_p(h, lp["mlp_post_norm"], eps, sp1)
             x = x + h
-            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+            x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
         return (x, aux), None
 
     if cfg.remat:
@@ -246,16 +247,12 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     if R % Pn != 0:
         raise ValueError(
             f"n_repeats={R} must be divisible by the pipe axis ({Pn})")
-    if mesh.shape[AXIS_CONTEXT] > 1:
-        raise NotImplementedError(
-            "pipelined meshes require context=1 (ring/a2a attention "
-            "shard-maps do not compose with the stage-folded batch dim)")
-    if impl not in ("xla", "flash"):
-        # forward() remaps ring/a2a→flash (with the S%128 dense fallback)
-        # before routing here; direct callers must do the same
-        raise ValueError(
-            f"pipeline_blocks supports attn impl 'xla'/'flash', got "
-            f"{impl!r} — remap context-parallel impls before calling")
+    if impl not in ("xla", "flash", "ring", "a2a"):
+        raise ValueError(f"unknown attn impl {impl!r}")
+    # context-parallel attention composes: ring/a2a take the stage-folded
+    # batch spec (ops/dispatch.py batch_axes) and the seq dims of every
+    # buffer shard over `context`
+    seq_ax = AXIS_CONTEXT if mesh.shape[AXIS_CONTEXT] > 1 else None
     Rp = R // Pn
     B, S, D = x.shape
     M = int(n_microbatches) if n_microbatches else Pn
@@ -301,12 +298,12 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
             [a, jnp.broadcast_to(a[-1:], (Pn - 1,) + a.shape[1:])])
 
     xm = _constrain(pad_drain(x.reshape(M, Bm, S, D)), mesh,
-                    None, BATCH_AXES, None, None)
+                    None, BATCH_AXES, seq_ax, None)
     pm = pad_drain(positions.reshape(M, Bm, S))
     sm = pad_drain(segment_ids.reshape(M, Bm, S))
 
     buf = _constrain(jnp.zeros((Pn, Bm, S, D), x.dtype), mesh,
-                     AXIS_PIPE, BATCH_AXES, None, None)
+                     AXIS_PIPE, BATCH_AXES, seq_ax, None)
     pbuf = jnp.zeros((Pn, Bm, S), pm.dtype)
     sbuf = jnp.ones((Pn, Bm, S), sm.dtype)
 
@@ -318,10 +315,10 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
         buf = jnp.roll(buf, 1, axis=0).at[0].set(x_in)
         pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(p_in)
         sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(s_in)
-        buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, None, None)
+        buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
         buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r,
                                       cfg, impl, dtype, rope, mesh,
-                                      lora_scale)
+                                      lora_scale, seq_ax)
         # MoE router aux: stage p holds microbatch t-p this tick —
         # warmup/drain passes over garbage slots must not contribute
         mb = t - jnp.arange(Pn)

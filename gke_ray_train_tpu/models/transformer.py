@@ -320,8 +320,9 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             and mesh.shape[AXIS_CONTEXT] == 1:
         # on a pipelined mesh with context=1, ring/a2a equal flash — remap
         # BEFORE the S%128 check below so odd lengths still get the dense
-        # fallback instead of crashing in the kernel (context>1 is
-        # rejected by pipeline_blocks)
+        # fallback instead of crashing in the kernel (with context>1 the
+        # impl passes through: the CP kernels take the stage-folded batch
+        # spec via dispatch's batch_axes)
         impl = "flash"
     if impl == "flash" and S % 128 != 0:
         # flash needs a 128-multiple sequence to tile; odd eval/infer

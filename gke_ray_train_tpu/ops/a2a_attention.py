@@ -51,7 +51,8 @@ def a2a_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                   sliding_window: Optional[int] = None,
                   scale: Optional[float] = None,
                   logit_softcap: Optional[float] = None,
-                  interpret: Optional[bool] = None) -> jnp.ndarray:
+                  interpret: Optional[bool] = None,
+                  batch_axes=BATCH_AXES) -> jnp.ndarray:
     """Context-parallel attention; q [B, S, H, dh], k/v [B, S, K, dh]
     sharded over (batch: data x fsdp, seq: context, heads: model) — the
     same contract as ring_attention. S is the GLOBAL sequence length.
@@ -103,8 +104,10 @@ def a2a_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         return jax.lax.all_to_all(out, AXIS_CONTEXT, split_axis=1,
                                   concat_axis=2, tiled=True)
 
-    qkv_spec = P(BATCH_AXES, AXIS_CONTEXT, AXIS_MODEL, None)
-    vec_spec = P(BATCH_AXES, AXIS_CONTEXT)
+    # batch_axes: (data, fsdp) normally; (pipe, data, fsdp) for the
+    # pipeline path's stage-folded batch (models/pipeline.py)
+    qkv_spec = P(batch_axes, AXIS_CONTEXT, AXIS_MODEL, None)
+    vec_spec = P(batch_axes, AXIS_CONTEXT)
     return shard_map(
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec,

@@ -104,7 +104,8 @@ def attention_dispatch(impl: str, q, k, v, *,
             kv_positions=kv_positions, q_segment_ids=q_segment_ids,
             kv_segment_ids=kv_segment_ids, causal=causal,
             sliding_window=sliding_window, scale=scale,
-            logit_softcap=logit_softcap, interpret=interpret)
+            logit_softcap=logit_softcap, interpret=interpret,
+            batch_axes=batch_axes)
     if impl == "a2a":
         from gke_ray_train_tpu.ops.a2a_attention import (
             a2a_attention, a2a_supported)
@@ -126,11 +127,12 @@ def attention_dispatch(impl: str, q, k, v, *,
                 kv_segment_ids=kv_segment_ids, causal=causal,
                 sliding_window=sliding_window, scale=scale,
                 logit_softcap=logit_softcap, mesh=mesh,
-                interpret=interpret)
+                interpret=interpret, batch_axes=batch_axes)
         return a2a_attention(
             q, k, v, mesh=mesh, q_positions=q_positions,
             kv_positions=kv_positions, q_segment_ids=q_segment_ids,
             kv_segment_ids=kv_segment_ids, causal=causal,
             sliding_window=sliding_window, scale=scale,
-            logit_softcap=logit_softcap, interpret=interpret)
+            logit_softcap=logit_softcap, interpret=interpret,
+            batch_axes=batch_axes)
     raise ValueError(f"unknown attn_impl {impl!r}")

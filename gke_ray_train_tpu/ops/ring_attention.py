@@ -146,7 +146,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                    logit_softcap: Optional[float] = None,
                    block_q: int = fa.DEFAULT_BLOCK_Q,
                    block_kv: int = fa.DEFAULT_BLOCK_KV,
-                   interpret: Optional[bool] = None) -> jnp.ndarray:
+                   interpret: Optional[bool] = None,
+                   batch_axes=BATCH_AXES) -> jnp.ndarray:
     """Context-parallel attention; q [B, S, H, dh], k/v [B, S, K, dh]
     sharded over (batch: data x fsdp, seq: context, heads: model).
 
@@ -197,8 +198,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             axis_name=AXIS_CONTEXT, size=C, kw=kw)
         return out.transpose(0, 2, 1, 3)
 
-    qkv_spec = P(BATCH_AXES, AXIS_CONTEXT, AXIS_MODEL, None)
-    vec_spec = P(BATCH_AXES, AXIS_CONTEXT)
+    # batch_axes: (data, fsdp) normally; (pipe, data, fsdp) for the
+    # pipeline path's stage-folded batch (models/pipeline.py)
+    qkv_spec = P(batch_axes, AXIS_CONTEXT, AXIS_MODEL, None)
+    vec_spec = P(batch_axes, AXIS_CONTEXT)
     return shard_map(
         local, mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec,
