@@ -136,6 +136,24 @@ def test_moe_forward_sharded_matches_unsharded(tp_mesh):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_moe_forward_context_sharded_matches_unsharded():
+    """MoE x CP: the dispatch cumsum runs over a context-SHARDED
+    sequence axis (GSPMD associative-scan collectives) — logits must
+    still be exact."""
+    cfg = moe_cfg(attn_impl="xla")
+    params = init_params(cfg, jax.random.key(8))
+    tokens = jnp.asarray(
+        np.random.default_rng(15).integers(0, cfg.vocab_size, (4, 16)),
+        jnp.int32)
+    ref = forward(params, tokens, cfg)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=1, model=2, context=2))
+    sharded = shard_tree(params, mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_moe_train_step_aux_and_updates(fsdp_mesh):
     """Full jitted train step on an MoE model: finite loss, router and
     every expert receive gradient updates, aux term reported."""
