@@ -399,9 +399,11 @@ def bench_moe():
     n_dev = len(devices)
     on_tpu = devices[0].platform != "cpu"
     if on_tpu:
-        # ~2.6B total / ~1B active with every MoE mechanism live
-        size = dict(d_model=1024, n_layers=12, n_heads=16, n_kv_heads=8,
-                    d_ff=4096, vocab_size=32768)
+        # ~0.5B total / ~0.16B active with every MoE mechanism live —
+        # fp32 params + Adam moments must fit 16 GB alongside the
+        # dispatch/combine buffers (a 2.6B fp32 MoE needs ~31 GB)
+        size = dict(d_model=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+                    d_ff=2048, vocab_size=32768)
         B, S, steps = 8, 1024, 10
     else:
         size = dict(d_model=128, n_layers=2, n_heads=4, n_kv_heads=2,
