@@ -478,13 +478,26 @@ def bench_decode():
 
 def main():
     mode = os.environ.get("BENCH_MODE", "train")
-    try:
-        jax.devices()
-    except RuntimeError as e:
-        # the tunneled dev TPU can be plain unavailable (observed:
-        # 'UNAVAILABLE: TPU backend setup/compile error' for hours) —
-        # emit an honest machine-readable record instead of crashing
-        # with no bench line at all
+    # the tunneled dev TPU can be plain unavailable for hours — and in
+    # the worst mode jax.devices() HANGS instead of raising (observed
+    # r4: the tunnel accepts the connection and never answers). Probe
+    # in a daemon thread so a dead backend yields an honest
+    # machine-readable record instead of a wedged bench process.
+    import threading
+    probe_result = {}
+
+    def _probe():
+        try:
+            probe_result["devices"] = jax.devices()
+        except Exception as e:  # noqa: BLE001 - any init failure
+            probe_result["error"] = e
+
+    th = threading.Thread(target=_probe, daemon=True)
+    th.start()
+    th.join(float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "240")))
+    if "devices" not in probe_result:
+        e = probe_result.get(
+            "error", TimeoutError("jax.devices() unresponsive"))
         print(json.dumps({
             "metric": f"bench {mode} NOT RUN - accelerator backend "
                       "init failed",
