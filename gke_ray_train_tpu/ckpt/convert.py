@@ -144,6 +144,14 @@ def convert(orbax_dir: str, out_dir: str, *, step: int = None,
         mgr.close()
     w.finish()
     write_hf_config(cfg, out_dir, dtype)
+    # carry the tokenizer through: the export step saves it under
+    # <orbax_dir>/tokenizer so the converted dir is a self-contained
+    # artifact (reference ships the tokenizer with every model dir,
+    # fine_tune_llama_ray.py:355,374)
+    tok_dir = os.path.join(orbax_dir, "tokenizer")
+    if os.path.isdir(tok_dir):
+        import shutil
+        shutil.copytree(tok_dir, out_dir, dirs_exist_ok=True)
     logger.info("converted %s (step %s) -> %s", orbax_dir, step, out_dir)
     return out_dir
 

@@ -1,5 +1,6 @@
 from gke_ray_train_tpu.data.tokenizer import (  # noqa: F401
     CharTokenizer, ByteTokenizer, load_hf_tokenizer,
+    load_saved_tokenizer, save_tokenizer,
     PAD_ID, BOS_ID, EOS_ID, UNK_ID)
 from gke_ray_train_tpu.data.lm_dataset import (  # noqa: F401
     SlidingWindowDataset, ShardedBatches)

@@ -52,9 +52,11 @@ class CharTokenizer:
                        if int(i) not in _RESERVED)
 
     def save(self, path: str) -> None:
+        """One serialization shared with the artifact sidecar
+        (save_tokenizer): {"type": "char", "stoi": ...}."""
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
-            json.dump({"stoi": self.stoi}, f)
+            json.dump({"type": "char", "stoi": self.stoi}, f)
 
     @classmethod
     def load(cls, path: str) -> "CharTokenizer":
@@ -97,3 +99,47 @@ def load_hf_tokenizer(model_id: str, hf_token: Optional[str] = None):
     if tok.pad_token is None:
         tok.pad_token = tok.eos_token
     return tok
+
+
+# sidecar name for the non-HF tokenizers; deliberately NOT
+# "tokenizer.json" (that name belongs to HF fast-tokenizer files)
+GRAFT_TOKENIZER_FILE = "graft_tokenizer.json"
+
+
+def save_tokenizer(tok, out_dir: str) -> None:
+    """Save the tokenizer next to the model weights so the export dir is
+    a self-contained artifact (the reference ships the tokenizer with
+    every merged/full model — fine_tune_llama_ray.py:355,374, and with
+    the pre-train checkpoint — pytorch_llm_ray.py tokenizer save).
+
+    HF tokenizers write their standard files (``tokenizer_config.json``
+    etc. — ``AutoTokenizer.from_pretrained(out_dir)`` then loads the dir
+    directly); ByteTokenizer/CharTokenizer write a small JSON sidecar
+    that :func:`load_saved_tokenizer` round-trips."""
+    os.makedirs(out_dir, exist_ok=True)
+    if hasattr(tok, "save_pretrained"):
+        tok.save_pretrained(out_dir)
+        return
+    path = os.path.join(out_dir, GRAFT_TOKENIZER_FILE)
+    if isinstance(tok, CharTokenizer):
+        tok.save(path)  # same {"type","stoi"} format as CharTokenizer
+    elif isinstance(tok, ByteTokenizer):
+        with open(path, "w") as f:
+            json.dump({"type": "byte"}, f)
+    else:
+        raise TypeError(f"cannot save tokenizer of type {type(tok)!r}")
+
+
+def load_saved_tokenizer(model_dir: str):
+    """Load whatever :func:`save_tokenizer` put in ``model_dir``:
+    the graft sidecar when present, else AutoTokenizer conventions
+    (the same call a reference user makes on its output dirs)."""
+    sidecar = os.path.join(model_dir, GRAFT_TOKENIZER_FILE)
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            data = json.load(f)
+        # legacy char files predate the "type" field but carry "stoi"
+        if data.get("type") == "char" or "stoi" in data:
+            return CharTokenizer(data["stoi"])
+        return ByteTokenizer()
+    return load_hf_tokenizer(model_dir)

@@ -138,20 +138,25 @@ def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
     return _proj_p(out, lp["wo"], lr("wo"), lora_scale, dtype)
 
 
-def _moe_p(x, lp, cfg: ModelConfig, dtype):
+def _moe_p(x, lp, cfg: ModelConfig, dtype, w):
     """Stage-batched MoE MLP: vmap the plain moe_mlp over the stage dim
     (each stage owns different expert weights). Returns (y [P,Bm,S,D],
     per-stage aux [P]). Dispatch capacity is per sequence row, so the
     routing inside one microbatch is IDENTICAL to the unpipelined layer;
     only the aux statistic becomes a mean over (stage, microbatch)
-    submeans instead of one joint batch mean."""
+    submeans instead of one joint batch mean. ``w`` [P,Bm,S] are the
+    token weights riding the stage buffers — all-zero on WARMUP slots
+    (zero-initialized buffer), but drain slots replay the last
+    microbatch's real weights: the tick's ``(mb>=0)&(mb<M)`` mask is
+    what actually excludes garbage passes from the aux."""
     from gke_ray_train_tpu.ops.moe import moe_mlp
 
-    def one_stage(xs, router, w_gate, w_up, w_down):
-        return moe_mlp(xs, router, w_gate, w_up, w_down, cfg, dtype)
+    def one_stage(xs, router, w_gate, w_up, w_down, ws):
+        return moe_mlp(xs, router, w_gate, w_up, w_down, cfg, dtype,
+                       weights=ws)
 
     return jax.vmap(one_stage)(x, lp["router"], lp["w_gate"],
-                               lp["w_up"], lp["w_down"])
+                               lp["w_up"], lp["w_down"], w)
 
 
 def _mlp_p(x, lp, cfg: ModelConfig, dtype, lora_p, lora_scale):
@@ -168,8 +173,8 @@ def _mlp_p(x, lp, cfg: ModelConfig, dtype, lora_p, lora_scale):
     return _proj_p(act * up, lp["w_down"], lr("w_down"), lora_scale, dtype)
 
 
-def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
-                   dtype, rope, mesh, lora_scale, seq_ax=None):
+def _stage_repeats(x, pos, seg, w, blocks_r, lora_r, cfg: ModelConfig,
+                   impl, dtype, rope, mesh, lora_scale, seq_ax=None):
     """Apply each stage's R/P local repeats to its buffer slot.
 
     Mirrors transformer.repeat_body, stage-batched; scanned over the
@@ -208,7 +213,7 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
             x = _constrain(x, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
             h = _norm_p(x, lp["mlp_norm"], eps, sp1)
             if moe:
-                h, a = _moe_p(h, lp, cfg, dtype)
+                h, a = _moe_p(h, lp, cfg, dtype, w)
                 aux = aux + a
             else:
                 h = _mlp_p(h, lp, cfg, dtype, lo, lora_scale)
@@ -234,7 +239,8 @@ def _stage_repeats(x, pos, seg, blocks_r, lora_r, cfg: ModelConfig, impl,
 def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
                     impl: str, dtype, rope, positions, segment_ids,
                     lora_blocks=None, lora_scale: float = 1.0,
-                    n_microbatches: Optional[int] = None):
+                    n_microbatches: Optional[int] = None,
+                    token_weights=None):
     """Run the stacked decoder blocks pipelined over the ``pipe`` axis.
 
     x: embedded activations [B, S, D] (batch sharded over (data, fsdp),
@@ -285,6 +291,9 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
             jnp.arange(S, dtype=jnp.int32), (B, S))
     if segment_ids is None:
         segment_ids = jnp.ones((B, S), jnp.int32)
+    if token_weights is None:
+        # all-ones = unweighted router aux (weighted mean == plain mean)
+        token_weights = jnp.ones((B, S), jnp.float32)
 
     # microbatch streams ride the tick scan as xs (static per-iteration
     # slices — a traced dynamic_index over the microbatch dim forces the
@@ -301,35 +310,43 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
                     None, BATCH_AXES, seq_ax, None)
     pm = pad_drain(positions.reshape(M, Bm, S))
     sm = pad_drain(segment_ids.reshape(M, Bm, S))
+    wm = pad_drain(token_weights.astype(jnp.float32).reshape(M, Bm, S))
 
     buf = _constrain(jnp.zeros((Pn, Bm, S, D), x.dtype), mesh,
                      AXIS_PIPE, BATCH_AXES, seq_ax, None)
     pbuf = jnp.zeros((Pn, Bm, S), pm.dtype)
     sbuf = jnp.ones((Pn, Bm, S), sm.dtype)
+    # weight buffer starts all-zero, nulling WARMUP-slot aux; drain
+    # ticks replay real weights (pad_drain), so the tick mask below is
+    # load-bearing for them — do not remove it as redundant
+    wbuf = jnp.zeros((Pn, Bm, S), jnp.float32)
 
     def tick(carry, xs_t):
-        buf, pbuf, sbuf, aux = carry
-        x_in, p_in, s_in, t = xs_t
+        buf, pbuf, sbuf, wbuf, aux = carry
+        x_in, p_in, s_in, w_in, t = xs_t
         # shift: stage p receives stage p-1's activation (one-hop
         # collective-permute on the pipe ring), stage 0 gets microbatch t
         buf = jnp.roll(buf, 1, axis=0).at[0].set(x_in)
         pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(p_in)
         sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(s_in)
+        wbuf = jnp.roll(wbuf, 1, axis=0).at[0].set(w_in)
         buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
-        buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, blocks_r, lora_r,
-                                      cfg, impl, dtype, rope, mesh,
-                                      lora_scale, seq_ax)
+        buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, wbuf, blocks_r,
+                                      lora_r, cfg, impl, dtype, rope,
+                                      mesh, lora_scale, seq_ax)
         # MoE router aux: stage p holds microbatch t-p this tick —
-        # warmup/drain passes over garbage slots must not contribute
+        # warmup/drain passes over garbage slots must not contribute.
+        # This mask is the sole guard for DRAIN slots (their wbuf holds
+        # the replayed last microbatch's real weights)
         mb = t - jnp.arange(Pn)
         aux = aux + jnp.sum(aux_vec * ((mb >= 0) & (mb < M)))
         # emit the last stage's slot; microbatch m surfaces at tick
         # m + Pn-1, so ys[Pn-1:] is exactly [0..M) in order
-        return (buf, pbuf, sbuf, aux), buf[Pn - 1]
+        return (buf, pbuf, sbuf, wbuf, aux), buf[Pn - 1]
 
-    (_, _, _, aux), ys = jax.lax.scan(
-        tick, (buf, pbuf, sbuf, jnp.zeros((), jnp.float32)),
-        (xm, pm, sm, jnp.arange(T)))
+    (_, _, _, _, aux), ys = jax.lax.scan(
+        tick, (buf, pbuf, sbuf, wbuf, jnp.zeros((), jnp.float32)),
+        (xm, pm, sm, wm, jnp.arange(T)))
     out = ys[Pn - 1:]
     # aux summed over (every layer) x (every microbatch): /M leaves the
     # same sum-over-layers scale the plain path returns (forward then

@@ -273,7 +273,8 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lora_dropout: float = 0.0,
             lora_rng: Optional[jax.Array] = None,
             pipe_microbatches: Optional[int] = None,
-            with_aux: bool = False):
+            with_aux: bool = False,
+            token_weights: Optional[jnp.ndarray] = None):
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``lora``: optional adapter pytree from train/lora.py (same block
@@ -290,6 +291,10 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     ``with_aux``: return ``(logits, {"router_aux": scalar})`` — the mean
     per-layer Switch load-balance loss (MoE models; 0.0 for dense). The
     train step requests it when cfg.n_experts > 0.
+
+    ``token_weights`` (optional [B, S]): passed to the MoE router aux so
+    load balance is computed over REAL tokens, not padding (the train
+    step passes the loss weights; ADVICE r4). Ignored by dense models.
     """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -344,7 +349,8 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             x, params["blocks"], cfg, mesh, impl=impl, dtype=dtype,
             rope=rope, positions=positions, segment_ids=segment_ids,
             lora_blocks=lora["blocks"] if lora is not None else None,
-            lora_scale=lora_scale, n_microbatches=pipe_microbatches)
+            lora_scale=lora_scale, n_microbatches=pipe_microbatches,
+            token_weights=token_weights)
         logits = _unembed(x, params, cfg, dtype, mesh)
         if with_aux:
             return logits, {"router_aux": pipe_aux / cfg.n_layers}
@@ -396,7 +402,8 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
                 # pair could target across routed experts.
                 from gke_ray_train_tpu.ops.moe import moe_mlp
                 h, a = moe_mlp(h, lp["router"], lp["w_gate"], lp["w_up"],
-                               lp["w_down"], cfg, dtype)
+                               lp["w_down"], cfg, dtype,
+                               weights=token_weights)
                 aux = aux + a
             else:
                 h = _mlp(h, lp, cfg, dtype, lora_p=lo,

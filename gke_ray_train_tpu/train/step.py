@@ -171,14 +171,16 @@ def make_train_step(cfg: ModelConfig,
                           lora_dropout=lora_dropout,
                           lora_rng=drop_rng,
                           pipe_microbatches=pipe_microbatches,
-                          with_aux=moe)
+                          with_aux=moe,
+                          token_weights=micro["weights"] if moe else None)
         else:
             out = forward(trainable, micro["inputs"], cfg,
                           positions=micro.get("positions"),
                           segment_ids=micro.get("segment_ids"),
                           mesh=mesh,
                           pipe_microbatches=pipe_microbatches,
-                          with_aux=moe)
+                          with_aux=moe,
+                          token_weights=micro["weights"] if moe else None)
         logits, aux = out if moe else (out, None)
         nll, w = token_nll(logits, micro["targets"], micro["weights"])
         if moe:

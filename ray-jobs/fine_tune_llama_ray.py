@@ -347,7 +347,13 @@ def train_loop_per_worker(config: dict):
             merged = merge_lora(state.params, state.lora, lora_cfg,
                                 on_host=True)
         save_hf_checkpoint(merged, cfg, final_dir)
-        logger.info("saved final model to %s", final_dir)
+        # tokenizer beside the weights — the output dir must be a
+        # self-contained artifact the user can hand straight to
+        # AutoTokenizer/from_pretrained, matching the reference
+        # (fine_tune_llama_ray.py:355,374)
+        from gke_ray_train_tpu.data import save_tokenizer
+        save_tokenizer(tokenizer, final_dir)
+        logger.info("saved final model + tokenizer to %s", final_dir)
     elif n_hosts > 1:
         if use_lora:
             # sharded across hosts: each device holds 1/N of the
@@ -367,6 +373,12 @@ def train_loop_per_worker(config: dict):
         export_mgr.wait()
         if ctx.is_host0():
             write_sidecar(cfg, final_dir + "_orbax")
+            # tokenizer rides in a subdir of the orbax export; the
+            # offline converter copies it into the final HF dir so the
+            # multi-host artifact is self-contained too
+            from gke_ray_train_tpu.data import save_tokenizer
+            save_tokenizer(tokenizer,
+                           os.path.join(final_dir + "_orbax", "tokenizer"))
     if use_lora:
         # LoRA-mode inference below uses base + adapters, never the
         # merged tree — release it (the 8B host merge holds ~32 GB)

@@ -113,11 +113,17 @@ def train_loop_per_worker(config: dict):
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
 
     step_fn = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
-    mgr = CheckpointManager(
-        os.path.join(config.get("storage_path",
-                                "/mnt/pvc/ray_llm_training_runs"),
-                     config.get("run_name", "basic_lm")),
-        max_to_keep=1, score_attribute="loss", score_mode="min")
+    run_dir = os.path.join(
+        config.get("storage_path", "/mnt/pvc/ray_llm_training_runs"),
+        config.get("run_name", "basic_lm"))
+    mgr = CheckpointManager(run_dir, max_to_keep=1,
+                            score_attribute="loss", score_mode="min")
+    if ctx.is_host0():
+        # tokenizer beside the checkpoints: the run dir alone is enough
+        # to decode/resume (reference saves the tokenizer with the
+        # pre-train artifact too)
+        from gke_ray_train_tpu.data import save_tokenizer
+        save_tokenizer(tok, run_dir)
 
     meter = ThroughputMeter(cfg, seq_len=seq_len,
                             n_devices=len(jax.devices()))

@@ -59,3 +59,10 @@ def test_entry_branches_run_and_learn_shape(tmp_path, over,
     assert metrics and "loss" in metrics, metrics
     assert metrics["loss"] > 0 and metrics["loss"] < 50
     assert "eval_loss" in metrics
+    # the final artifact dir is self-contained: weights AND tokenizer
+    # (reference fine_tune_llama_ray.py:355,374); offline → ByteTokenizer
+    from gke_ray_train_tpu.data import ByteTokenizer, load_saved_tokenizer
+    sub = "merged" if over.get("USE_QLORA") else "full"
+    final_dir = os.path.join(str(tmp_path / "out"), sub)
+    assert os.path.isdir(final_dir), os.listdir(str(tmp_path / "out"))
+    assert isinstance(load_saved_tokenizer(final_dir), ByteTokenizer)
