@@ -52,7 +52,7 @@ KNOWN_KEYS = frozenset({
     "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
     "MESH_DATA", "MESH_FSDP",
     "MESH_MODEL", "MESH_CONTEXT", "MESH_PIPE", "PIPE_MICROBATCHES",
-    "NUM_SLICES", "SMOKE_TEST",
+    "PIPE_VIRTUAL_STAGES", "NUM_SLICES", "SMOKE_TEST",
     # profiling / debug (train/profiling.py)
     "PROFILE", "PROFILE_START_STEP", "PROFILE_NUM_STEPS", "DEBUG_NANS",
 })

@@ -82,6 +82,12 @@ class ModelConfig:
     attn_impl: str = "auto"     # "auto" | "xla" | "flash" | "ring" | "a2a"
     # "auto" resolves at trace time: flash (Pallas) on TPU, xla oracle off-TPU
 
+    # pipeline schedule (models/pipeline.py): virtual stage groups per
+    # device. 1 = plain shift buffer; v>1 = circular/interleaved (each
+    # device owns v non-contiguous layer groups; see the pipeline module
+    # docstring for the honest bubble table). Only read on pipe>1 meshes.
+    pipe_virtual: int = 1
+
     def __post_init__(self):
         # keep the config hashable (jit static arg): dicts → sorted tuples
         if isinstance(self.rope_scaling, dict):
@@ -108,6 +114,8 @@ class ModelConfig:
             raise ValueError(f"unknown attn_impl {self.attn_impl!r}")
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(f"unknown remat_policy {self.remat_policy!r}")
+        if self.pipe_virtual < 1:
+            raise ValueError(f"pipe_virtual={self.pipe_virtual} must be >= 1")
 
     def to_dict(self) -> dict:
         """JSON-serializable form (offline converter sidecar files)."""

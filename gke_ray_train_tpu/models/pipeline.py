@@ -25,6 +25,34 @@ pipelining recipe):
   reverse permutes, so the backward pass is the mirrored pipeline with
   no hand-written schedule.
 
+Circular / interleaved schedule (``cfg.pipe_virtual = v > 1``): each
+device owns ``v`` NON-contiguous layer groups of ``R/(P·v)`` repeats
+(device p owns groups ``{j·P+p}``); the stage buffer generalizes to
+``[v, P, ...]`` and a microbatch loops the device ring ``v`` times.
+``v = 1`` IS the plain shift schedule (one code path).
+
+Honest bubble accounting for this homogeneous-scan formulation — every
+tick costs the same R/P repeats per device whether a slot holds real
+data or garbage, so "bubble" here means garbage-slot compute:
+
+| schedule      | ticks        | garbage fraction    |
+|---------------|--------------|---------------------|
+| shift (v=1)   | M + P - 1    | (P-1)/(M+P-1)       |
+| circular (v)  | M + vP - 1   | (vP-1)/(M+vP-1)     |
+
+i.e. circular does NOT cut the scan-form bubble — the Megatron-style
+``(P-1)/(Mv+P-1)`` figure requires a heterogeneous 1F1B schedule that a
+single jitted scan (and its autodiff transpose) cannot express. What
+circular buys here is finer-grained stages (first-token latency R/(vP)
+per hop, relevant for inference pipelining) at the cost of one
+gather-style param regroup per forward (non-contiguous ownership vs the
+contiguous ``pipe``-sharded storage). The REAL bubble lever on TPU is
+``M``: fold grad-accum microbatches into ``pipe_microbatches`` (set
+``GRADIENT_ACCUMULATION_STEPS=1`` and ``PIPE_MICROBATCHES=G·M``) so one
+pipeline pass amortizes its P-1 warmup over the whole accumulation
+window — the loss is a per-token sum either way, so the math is
+identical. Measured tick counts are pinned by tests/test_pipeline.py.
+
 Composability: the batch dim stays sharded over ``(data, fsdp)``,
 head/ffn dims over ``model``, and the sequence dim over ``context``
 *inside* the pipeline (the stage dim is just one more array axis to
@@ -34,12 +62,12 @@ stage-folded ``(pipe, data, fsdp)`` batch spec through the dispatch's
 
 Correctness notes:
 - Warmup ticks process zero buffers and drain ticks replay the last
-  microbatch; microbatch m surfaces from the last stage at tick
-  m + P - 1, so the harvest is simply the last M scan outputs
-  (``ys[P-1:]``) — garbage emissions fall outside the window and get
-  zero cotangent in the backward pass. The one thing that DOES need
-  masking is the MoE router aux, which would otherwise count the
-  garbage passes (see the validity mask in the tick body).
+  microbatch; microbatch m surfaces from the last slot at tick
+  m + depth - 1 (depth = v·P hops), so the harvest is simply the last M
+  scan outputs (``ys[depth-1:]``) — garbage emissions fall outside the
+  window and get zero cotangent in the backward pass. The one thing
+  that DOES need masking is the MoE router aux, which would otherwise
+  count the garbage passes (see the validity mask in the tick body).
 - LoRA adapters ride along as stage-batched einsums (QLoRA bases
   dequantize per stage-slice); LoRA *dropout* is not supported on a
   pipelined mesh — the per-repeat rng fold-in would need a per-stage
@@ -67,6 +95,23 @@ from gke_ray_train_tpu.parallel.mesh import (
 
 # the folded (stage * microbatch) leading dim of attention inputs
 STAGE_BATCH_AXES = (AXIS_PIPE,) + BATCH_AXES
+
+_warned_shallow = set()
+
+
+def _warn_shallow_microbatches(M: int, V: int, Pn: int) -> None:
+    """Trace-time (once per shape) warning: fewer microbatches than
+    pipeline hops means the garbage fraction exceeds 50%."""
+    key = (M, V, Pn)
+    if key in _warned_shallow:
+        return
+    _warned_shallow.add(key)
+    import logging
+    depth = V * Pn
+    logging.getLogger(__name__).warning(
+        "pipeline has %d microbatches for depth %d (pipe=%d x virtual=%d):"
+        " garbage fraction is %d/%d — raise PIPE_MICROBATCHES to amortize",
+        M, depth, Pn, V, depth - 1, M + depth - 1)
 
 
 def _constrain(x, mesh: Optional[Mesh], *spec):
@@ -236,6 +281,39 @@ def _stage_repeats(x, pos, seg, w, blocks_r, lora_r, cfg: ModelConfig,
     return x, aux
 
 
+def _virtual_repeats(buf, pbuf, sbuf, wbuf, blocks_r, lora_r,
+                     cfg: ModelConfig, impl, dtype, rope, mesh,
+                     lora_scale, seq_ax):
+    """Apply every (virtual-group, device-stage) slot's local repeats.
+
+    buf [V, Pn, Bm, S, D]; blocks_r/lora_r leaves [Rg, V, Pn, ...].
+    V=1 (the default shift schedule) calls _stage_repeats directly —
+    byte-identical program to the pre-virtual implementation; V>1 vmaps
+    it over the virtual-group dim (device p's V groups are processed
+    within one tick, keeping per-tick cost at R/P repeats per device).
+    Returns (buf [V, Pn, ...], aux [V, Pn])."""
+    V = buf.shape[0]
+    if V == 1:
+        blocks1 = jax.tree.map(lambda l: l[:, 0], blocks_r)
+        lora1 = (jax.tree.map(lambda l: l[:, 0], lora_r)
+                 if lora_r is not None else None)
+        x, aux = _stage_repeats(buf[0], pbuf[0], sbuf[0], wbuf[0],
+                                blocks1, lora1, cfg, impl, dtype, rope,
+                                mesh, lora_scale, seq_ax)
+        return x[None], aux[None]
+
+    def one_group(x, p, s, w, b, lo):
+        return _stage_repeats(x, p, s, w, b, lo, cfg, impl, dtype, rope,
+                              mesh, lora_scale, seq_ax)
+
+    if lora_r is None:
+        return jax.vmap(
+            lambda x, p, s, w, b: one_group(x, p, s, w, b, None),
+            in_axes=(0, 0, 0, 0, 1))(buf, pbuf, sbuf, wbuf, blocks_r)
+    return jax.vmap(one_group, in_axes=(0, 0, 0, 0, 1, 1))(
+        buf, pbuf, sbuf, wbuf, blocks_r, lora_r)
+
+
 def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
                     impl: str, dtype, rope, positions, segment_ids,
                     lora_blocks=None, lora_scale: float = 1.0,
@@ -249,22 +327,30 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     outside) and the summed-over-layers MoE router aux (0.0 for dense).
     """
     Pn = int(mesh.shape[AXIS_PIPE])
+    V = int(cfg.pipe_virtual)  # >= 1 by ModelConfig validation
     R = cfg.n_repeats
-    if R % Pn != 0:
+    if R % (Pn * V) != 0:
         raise ValueError(
-            f"n_repeats={R} must be divisible by the pipe axis ({Pn})")
+            f"n_repeats={R} must be divisible by pipe axis x virtual "
+            f"stages ({Pn} x {V})")
     if impl not in ("xla", "flash", "ring", "a2a"):
         raise ValueError(f"unknown attn impl {impl!r}")
     # context-parallel attention composes: ring/a2a take the stage-folded
     # batch spec (ops/dispatch.py batch_axes) and the seq dims of every
     # buffer shard over `context`
     seq_ax = AXIS_CONTEXT if mesh.shape[AXIS_CONTEXT] > 1 else None
-    Rp = R // Pn
+    Rg = R // (Pn * V)
     B, S, D = x.shape
-    M = int(n_microbatches) if n_microbatches else Pn
+    # default M: one microbatch per HOP (depth = V*Pn) so the circular
+    # schedule is not born with a majority-garbage tick budget; an
+    # explicit n_microbatches below the depth still runs but is warned
+    # about once (garbage fraction (depth-1)/(M+depth-1) per the table)
+    M = int(n_microbatches) if n_microbatches else V * Pn
     if M < Pn:
         raise ValueError(
             f"pipeline microbatches ({M}) must be >= pipe stages ({Pn})")
+    if M < V * Pn:
+        _warn_shallow_microbatches(M, V, Pn)
     if B % M != 0:
         raise ValueError(
             f"batch {B} not divisible by {M} pipeline microbatches")
@@ -276,11 +362,14 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
             f"divisible by the batch-parallel extent {batch_par}; lower "
             f"pipe_microbatches or raise the batch")
 
-    # [R, ...] -> [Rp, Pn, ...]: stage-major split of the repeat dim, the
-    # split boundary coincides with the pipe shard boundary so no data
-    # moves; scan then slices one [Pn, ...] layer group per repeat.
+    # [R, ...] -> [Rg, V, Pn, ...]: group g = j*Pn + p (hop order ==
+    # layer order) owns repeats [g*Rg, (g+1)*Rg). For V=1 the split
+    # boundary coincides with the pipe shard boundary so no data moves;
+    # for V>1 ownership is non-contiguous and GSPMD regroups the params
+    # once per forward (outside the tick scan).
     def to_stages(leaf):
-        return leaf.reshape((Pn, Rp) + leaf.shape[1:]).swapaxes(0, 1)
+        return jnp.moveaxis(
+            leaf.reshape((V, Pn, Rg) + leaf.shape[1:]), 2, 0)
 
     blocks_r = jax.tree.map(to_stages, params_blocks)
     lora_r = (jax.tree.map(to_stages, lora_blocks)
@@ -298,13 +387,15 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     # microbatch streams ride the tick scan as xs (static per-iteration
     # slices — a traced dynamic_index over the microbatch dim forces the
     # SPMD partitioner into full rematerialization on reshard); drain
-    # ticks replay the last microbatch into stage 0 and their outputs
-    # are dropped by the static ys window below
-    T = M + Pn - 1
+    # ticks replay the last microbatch into slot (0,0) and their outputs
+    # are dropped by the static ys window below. Pipeline depth in hops
+    # is V*Pn (a microbatch loops the device ring V times).
+    depth = V * Pn
+    T = M + depth - 1
 
     def pad_drain(a):
         return jnp.concatenate(
-            [a, jnp.broadcast_to(a[-1:], (Pn - 1,) + a.shape[1:])])
+            [a, jnp.broadcast_to(a[-1:], (depth - 1,) + a.shape[1:])])
 
     xm = _constrain(pad_drain(x.reshape(M, Bm, S, D)), mesh,
                     None, BATCH_AXES, seq_ax, None)
@@ -312,42 +403,50 @@ def pipeline_blocks(x, params_blocks, cfg: ModelConfig, mesh: Mesh, *,
     sm = pad_drain(segment_ids.reshape(M, Bm, S))
     wm = pad_drain(token_weights.astype(jnp.float32).reshape(M, Bm, S))
 
-    buf = _constrain(jnp.zeros((Pn, Bm, S, D), x.dtype), mesh,
-                     AXIS_PIPE, BATCH_AXES, seq_ax, None)
-    pbuf = jnp.zeros((Pn, Bm, S), pm.dtype)
-    sbuf = jnp.ones((Pn, Bm, S), sm.dtype)
+    buf = _constrain(jnp.zeros((V, Pn, Bm, S, D), x.dtype), mesh,
+                     None, AXIS_PIPE, BATCH_AXES, seq_ax, None)
+    pbuf = jnp.zeros((V, Pn, Bm, S), pm.dtype)
+    sbuf = jnp.ones((V, Pn, Bm, S), sm.dtype)
     # weight buffer starts all-zero, nulling WARMUP-slot aux; drain
     # ticks replay real weights (pad_drain), so the tick mask below is
     # load-bearing for them — do not remove it as redundant
-    wbuf = jnp.zeros((Pn, Bm, S), jnp.float32)
+    wbuf = jnp.zeros((V, Pn, Bm, S), jnp.float32)
+
+    def shift(b, inj):
+        """Advance the (V, Pn) ring one hop: slot (j,p) <- (j,p-1); the
+        wrap (j-1, Pn-1) -> (j, 0) re-enters the device ring (device-
+        local move: both slots live on device 0's column after the
+        roll); slot (0,0) takes the injected microbatch."""
+        r = jnp.roll(b, 1, axis=1)         # one-hop collective-permute
+        c0 = jnp.roll(r[:, 0], 1, axis=0).at[0].set(inj)
+        return r.at[:, 0].set(c0)
 
     def tick(carry, xs_t):
         buf, pbuf, sbuf, wbuf, aux = carry
         x_in, p_in, s_in, w_in, t = xs_t
-        # shift: stage p receives stage p-1's activation (one-hop
-        # collective-permute on the pipe ring), stage 0 gets microbatch t
-        buf = jnp.roll(buf, 1, axis=0).at[0].set(x_in)
-        pbuf = jnp.roll(pbuf, 1, axis=0).at[0].set(p_in)
-        sbuf = jnp.roll(sbuf, 1, axis=0).at[0].set(s_in)
-        wbuf = jnp.roll(wbuf, 1, axis=0).at[0].set(w_in)
-        buf = _constrain(buf, mesh, AXIS_PIPE, BATCH_AXES, seq_ax, None)
-        buf, aux_vec = _stage_repeats(buf, pbuf, sbuf, wbuf, blocks_r,
-                                      lora_r, cfg, impl, dtype, rope,
-                                      mesh, lora_scale, seq_ax)
-        # MoE router aux: stage p holds microbatch t-p this tick —
+        buf = shift(buf, x_in)
+        pbuf = shift(pbuf, p_in)
+        sbuf = shift(sbuf, s_in)
+        wbuf = shift(wbuf, w_in)
+        buf = _constrain(buf, mesh, None, AXIS_PIPE, BATCH_AXES, seq_ax,
+                         None)
+        buf, aux_vec = _virtual_repeats(buf, pbuf, sbuf, wbuf, blocks_r,
+                                        lora_r, cfg, impl, dtype, rope,
+                                        mesh, lora_scale, seq_ax)
+        # MoE router aux: slot (j,p) holds microbatch t - (j*Pn + p) —
         # warmup/drain passes over garbage slots must not contribute.
         # This mask is the sole guard for DRAIN slots (their wbuf holds
         # the replayed last microbatch's real weights)
-        mb = t - jnp.arange(Pn)
+        mb = t - (jnp.arange(V)[:, None] * Pn + jnp.arange(Pn)[None, :])
         aux = aux + jnp.sum(aux_vec * ((mb >= 0) & (mb < M)))
-        # emit the last stage's slot; microbatch m surfaces at tick
-        # m + Pn-1, so ys[Pn-1:] is exactly [0..M) in order
-        return (buf, pbuf, sbuf, wbuf, aux), buf[Pn - 1]
+        # emit the last slot; microbatch m surfaces from (V-1, Pn-1) at
+        # tick m + depth-1, so ys[depth-1:] is exactly [0..M) in order
+        return (buf, pbuf, sbuf, wbuf, aux), buf[V - 1, Pn - 1]
 
     (_, _, _, _, aux), ys = jax.lax.scan(
         tick, (buf, pbuf, sbuf, wbuf, jnp.zeros((), jnp.float32)),
         (xm, pm, sm, wm, jnp.arange(T)))
-    out = ys[Pn - 1:]
+    out = ys[depth - 1:]
     # aux summed over (every layer) x (every microbatch): /M leaves the
     # same sum-over-layers scale the plain path returns (forward then
     # divides by n_layers)

@@ -98,11 +98,16 @@ def train_loop_per_worker(config: dict):
     if smoke:
         # smoke keeps its fp32-by-default dtypes (CPU numerics), but an
         # explicit PARAM_DTYPE rehearses the flagship memory behavior
+        # size the smoke model's depth to the pipeline: layers must
+        # divide by pipe stages x virtual groups or the forward raises
+        pipe_depth = (int(mesh.shape.get("pipe", 1))
+                      * int(config.get("PIPE_VIRTUAL_STAGES", 1)))
         cfg = tiny(vocab_size=max(getattr(tokenizer, "vocab_size", 260), 260),
                    max_seq_len=max_seq, dtype=config.get("TRAIN_DTYPE",
                                                          "float32"),
                    param_dtype=config.get("PARAM_DTYPE", "float32"),
-                   attn_impl=config.get("ATTN_IMPL", "auto"))
+                   attn_impl=config.get("ATTN_IMPL", "auto"),
+                   n_layers=max(2, pipe_depth))
     else:
         cfg = preset_for_model_id(
             model_id,
@@ -250,6 +255,12 @@ def train_loop_per_worker(config: dict):
     # pipeline-parallel meshes (MESH_PIPE>1) microbatch each forward;
     # 0/unset = default (one microbatch per stage)
     pipe_micro = int(config.get("PIPE_MICROBATCHES", 0)) or None
+    if "PIPE_VIRTUAL_STAGES" in config:
+        import dataclasses as _dc
+        # invalid values (0, negatives) must fail ModelConfig validation,
+        # not silently fall back to the shift schedule
+        cfg = _dc.replace(cfg,
+                          pipe_virtual=int(config["PIPE_VIRTUAL_STAGES"]))
     step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
                               grad_accum=grad_accum, schedule=schedule,
                               pipe_microbatches=pipe_micro)
