@@ -1,0 +1,104 @@
+"""JaxTrainer._fit_ray against a REAL local Ray cluster (VERDICT r4 next
+#8): the in-process fake (tests/test_rayint_cluster.py) pins the
+orchestration contract, but real-Ray serialization of the worker
+closure, placement-group scheduling, and actor lifecycle only execute
+here. Skipped wherever Ray is not installed (it is absent from the CI
+image; real deployments install it via the cluster runtime).
+"""
+
+import os
+
+import pytest
+
+ray = pytest.importorskip("ray")
+
+from gke_ray_train_tpu.rayint.trainer import (  # noqa: E402
+    FailureConfig, JaxTrainer, RunConfig, ScalingConfig)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_train_fn(config):
+    """Runs IN a Ray worker process: a real (single-process) tiny train
+    slice, then report through the trainer context. Deliberately does
+    not call distributed_init — two independent CPU jax processes can't
+    form one mesh without TPU hosts; the contract under test is the
+    REAL-Ray orchestration around the worker fn (D1), not collectives
+    (covered by the 2-process jax.distributed tests)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.rayint import get_context
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32", remat=False)
+    schedule = warmup_cosine_schedule(1e-3, 10)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, schedule=schedule)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inputs": rng.integers(0, 64, (4, 16)).astype(np.int32),
+        "targets": rng.integers(0, 64, (4, 16)).astype(np.int32),
+        "weights": np.ones((4, 16), np.float32),
+    }
+    losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        losses.append(float(jax.device_get(m["loss"])))
+    metrics = {
+        "loss": losses[-1],
+        "loss_decreased": losses[-1] < losses[0],
+        "process_id": int(os.environ.get("PROCESS_ID", "-1")),
+        "num_processes": int(os.environ.get("NUM_PROCESSES", "-1")),
+        "has_coordinator": "COORDINATOR_ADDRESS" in os.environ,
+        "pid": os.getpid(),
+    }
+    get_context().report(metrics)
+    return metrics
+
+
+@pytest.mark.slow
+def test_fit_ray_two_workers_end_to_end(tmp_path):
+    ray.init(
+        num_cpus=4, include_dashboard=False, ignore_reinit_error=True,
+        runtime_env={"env_vars": {
+            # worker processes import the site hook's jax too; force the
+            # CPU platform before any backend init in them
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+        }})
+    try:
+        trainer = JaxTrainer(
+            _tiny_train_fn,
+            train_loop_config={},
+            scaling_config=ScalingConfig(num_workers=2,
+                                         resources_per_worker={"CPU": 1},
+                                         placement_strategy="PACK"),
+            run_config=RunConfig(
+                name="real-ray-smoke", storage_path=str(tmp_path),
+                failure_config=FailureConfig(max_failures=0),
+                worker_timeout_s=300.0),
+            use_ray=True)
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.worker_metrics is not None
+        assert len(result.worker_metrics) == 2
+        # both workers really ran (distinct ranks, distinct processes),
+        # got the coordinator env, and trained
+        assert {m["process_id"] for m in result.worker_metrics} == {0, 1}
+        assert len({m["pid"] for m in result.worker_metrics}) == 2
+        for m in result.worker_metrics:
+            assert m["num_processes"] == 2
+            assert m["has_coordinator"]
+            assert m["loss_decreased"], m
+        # rank-0 convention for the top-level metrics
+        assert result.metrics["process_id"] == 0
+    finally:
+        ray.shutdown()
