@@ -303,6 +303,14 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
 
+    # NOTE on the SPMD "involuntary full rematerialization" warning this
+    # gather triggers on (fsdp x model) meshes: the table is stored
+    # P("model", "fsdp") so the output comes out D-sharded-over-fsdp and
+    # must reshard to batch-over-fsdp (the constraint below); XLA's
+    # fallback replicates ONE microbatch activation [B,S,D] per forward
+    # (~0.1% of an 8B step). The alternatives are worse: replicating the
+    # table costs ~1 GB of ICI per step at 8B, and a one-hot-matmul
+    # embedding materializes [B,S,V]. Benign — do not "fix" blindly.
     x = params["embed"].astype(dtype)[tokens]
     if cfg.embed_scale:
         x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype)

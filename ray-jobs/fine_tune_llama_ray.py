@@ -472,3 +472,9 @@ if __name__ == "__main__":
         logger.error("training failed: %s", result.error)
         sys.exit(1)
     logger.info("final metrics: %s", result.metrics)
+    # one machine-readable line on stdout (logging goes to stderr) so
+    # drivers/scripts (scripts/record_baselines.sh) can collect the
+    # job's meter numbers the same way they collect bench.py records
+    print(json.dumps({"metric": "flagship_final", **{
+        k: v for k, v in (result.metrics or {}).items()
+        if isinstance(v, (int, float))}}), flush=True)

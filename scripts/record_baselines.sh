@@ -33,4 +33,21 @@ run seq4k          env BENCH_MODE=seq4k python bench.py
 run moe            env BENCH_MODE=moe python bench.py
 run decode         env BENCH_MODE=decode python bench.py
 
+# flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
+# defaults on the seq4k shape where the kernel dominates (up to 8 extra
+# bench runs; the default q=256/kv=1024 cell IS the `seq4k` record
+# above and is skipped here)
+for q in 128 256 512; do
+  for kv in 512 1024 2048; do
+    [ "$q" = 256 ] && [ "$kv" = 1024 ] && continue
+    run "flash-q${q}-kv${kv}" env BENCH_MODE=seq4k \
+        FLASH_BLOCK_Q="$q" FLASH_BLOCK_KV="$kv" python bench.py
+  done
+done
+
+# flagship entry through its own meter (steady-state vs incl-stalls
+# since r5) — full job: train + eval + ckpt + merge + export
+run flagship env FINE_TUNE_CONFIG=ray-jobs/fine_tune_config_offline_8b.json \
+    python ray-jobs/fine_tune_llama_ray.py
+
 echo "records in $OUT"

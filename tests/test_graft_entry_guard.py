@@ -112,7 +112,7 @@ def test_dryrun_completes_with_hanging_jax_devices(entry_mod, monkeypatch,
     assert "GRAFT CPU-FALLBACK" in out
     assert "dryrun mesh" in out
     for line in ("dryrun ok", "dryrun qlora ok", "dryrun pp ok",
-                 "dryrun moe ok"):
+                 "dryrun pp circular ok", "dryrun moe ok"):
         assert line in out, f"missing {line!r} in:\n{out}"
 
 
@@ -132,5 +132,6 @@ def test_main_path_under_simulated_outage():
     assert "GRAFT CPU-FALLBACK" in r.stdout
     assert "entry forward:" in r.stdout
     for line in ("dryrun mesh", "dryrun ok", "dryrun qlora ok",
-                 "dryrun pp ok", "dryrun moe ok"):
+                 "dryrun pp ok", "dryrun pp circular ok",
+                 "dryrun moe ok"):
         assert line in r.stdout, f"missing {line!r} in:\n{r.stdout}"
