@@ -67,6 +67,52 @@ def test_restore_across_mesh_reshard(tmp_path, fsdp_mesh, dp_mesh):
     mgr.close()
 
 
+def test_train_state_resumes_across_mesh_reshard(tmp_path, fsdp_mesh,
+                                                 dp_mesh):
+    """Elastic reshape (SURVEY.md §7 hard part #3): train on a 2x4 mesh,
+    checkpoint the FULL TrainState (params + ZeRO-sharded AdamW moments
+    + step), restore onto an 8x1 mesh, keep training — the continued run
+    must match an uninterrupted single-mesh run step for step."""
+    from gke_ray_train_tpu.train import make_train_step
+
+    cfg = tiny(remat=False)
+    rng = np.random.default_rng(5)
+    batch = {
+        "inputs": rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32),
+        "weights": np.ones((8, 16), np.float32),
+    }
+
+    def losses(meshes):
+        """Run 4 steps, switching mesh (via ckpt) after step 2."""
+        opt = make_optimizer(1e-3)
+        state = make_train_state(cfg, opt, jax.random.key(0),
+                                 mesh=meshes[0])
+        step = make_train_step(cfg, opt, mesh=meshes[0], donate=False)
+        out = []
+        for _ in range(2):
+            state, m = step(state, batch)
+            out.append(float(jax.device_get(m["loss"])))
+        if meshes[1] is not meshes[0]:
+            mgr = CheckpointManager(str(tmp_path / "reshard"),
+                                    async_save=False)
+            mgr.save(2, state, force=True)
+            mgr.wait()
+            target = make_train_state(cfg, opt, jax.random.key(1),
+                                      mesh=meshes[1])
+            state = mgr.restore(target)
+            mgr.close()
+            step = make_train_step(cfg, opt, mesh=meshes[1], donate=False)
+        for _ in range(2):
+            state, m = step(state, batch)
+            out.append(float(jax.device_get(m["loss"])))
+        return out
+
+    uninterrupted = losses([fsdp_mesh, fsdp_mesh])
+    resharded = losses([fsdp_mesh, dp_mesh])
+    np.testing.assert_allclose(resharded, uninterrupted, rtol=1e-5)
+
+
 def test_hf_roundtrip_plain(tmp_path):
     """Export → import reproduces identical logits (fp32 export)."""
     cfg = tiny()

@@ -30,6 +30,9 @@ def entry_mod(monkeypatch):
     monkeypatch.setattr(mod, "_PROBE_RESULT", None)
     monkeypatch.delenv("GRAFT_CPU_FALLBACK", raising=False)
     monkeypatch.delenv("GRAFT_FORCE_PROBE", raising=False)
+    # a caller-exported slice override would skip the fifth dryrun pass
+    # (and re-shape the main passes) in respawned children
+    monkeypatch.delenv("DRYRUN_SLICES", raising=False)
     return mod
 
 
@@ -112,7 +115,8 @@ def test_dryrun_completes_with_hanging_jax_devices(entry_mod, monkeypatch,
     assert "GRAFT CPU-FALLBACK" in out
     assert "dryrun mesh" in out
     for line in ("dryrun ok", "dryrun qlora ok", "dryrun pp ok",
-                 "dryrun pp circular ok", "dryrun moe ok"):
+                 "dryrun pp circular ok", "dryrun moe ok",
+                 "dryrun multislice ok"):
         assert line in out, f"missing {line!r} in:\n{out}"
 
 
@@ -124,6 +128,7 @@ def test_main_path_under_simulated_outage():
     env = dict(os.environ)
     env["GRAFT_FORCE_PROBE"] = "hang"
     env.pop("GRAFT_CPU_FALLBACK", None)
+    env.pop("DRYRUN_SLICES", None)
     env["DRYRUN_DEVICES"] = "8"
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "__graft_entry__.py")],
@@ -133,5 +138,5 @@ def test_main_path_under_simulated_outage():
     assert "entry forward:" in r.stdout
     for line in ("dryrun mesh", "dryrun ok", "dryrun qlora ok",
                  "dryrun pp ok", "dryrun pp circular ok",
-                 "dryrun moe ok"):
+                 "dryrun moe ok", "dryrun multislice ok"):
         assert line in r.stdout, f"missing {line!r} in:\n{r.stdout}"
