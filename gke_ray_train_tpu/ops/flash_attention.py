@@ -71,6 +71,24 @@ def _softcap_fwd(s, cap):
     return jnp.tanh(s / cap) * cap if cap is not None else s
 
 
+def _block_live(q_pos, kv_pos, causal, window):
+    """Block-level skip predicate shared by fwd/dq/dkv kernels.
+
+    Dead block ⇔ no (q, kv) pair can be unmasked:
+    - causal future: every kv newer than every q;
+    - window-expired past: every kv at or older than every q - window
+      (mask keeps ``kv > q - window``, so max(kv) <= min(q) - window is
+      provably all-masked — conservative under packed/per-segment
+      positions, since any in-window pair violates it).
+    Predicated-off blocks still DMA but skip the matmuls — on long
+    sliding-window sequences (Gemma-2 4k+) this cuts the scanned KV
+    area from O(S²/2) to O(S·window)."""
+    live = (not causal) or (jnp.max(q_pos) >= jnp.min(kv_pos))
+    if window is not None:
+        live = live & (jnp.max(kv_pos) > jnp.min(q_pos) - window)
+    return live
+
+
 FULL_BLOCK_LIMIT = 2048  # max seq to load as one VMEM block
 
 
@@ -115,11 +133,9 @@ def _fwd_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    # block-level causal skip: the newest kv position this block holds vs
-    # the oldest query position — if every kv is in the future, the whole
-    # block is masked and the body is predicated off (DMA still happens,
-    # compute does not).
-    run = (not causal) or (jnp.max(q_pos) >= jnp.min(kv_pos))
+    # block-level skip (causal future + window-expired past): see
+    # _block_live. DMA still happens, compute does not.
+    run = _block_live(q_pos, kv_pos, causal, window)
 
     @pl.when(run)
     def _():
@@ -242,7 +258,7 @@ def _dq_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    run = (not causal) or (jnp.max(q_pos) >= jnp.min(kv_pos))
+    run = _block_live(q_pos, kv_pos, causal, window)
 
     @pl.when(run)
     def _():
@@ -278,7 +294,7 @@ def _dkv_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    run = (not causal) or (jnp.max(q_pos) >= jnp.min(kv_pos))
+    run = _block_live(q_pos, kv_pos, causal, window)
 
     @pl.when(run)
     def _():

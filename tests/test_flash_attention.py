@@ -86,6 +86,34 @@ def test_packed_segments_and_padding():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_window_expired_blocks_skipped_exactly():
+    """Long sliding-window sequence where whole KV blocks are BOTH
+    causally past and window-expired (S=512, window=64, 64-wide blocks:
+    e.g. q block [256,320) vs kv block [0,64) is dead) — the block-level
+    skip predicate (_block_live) must not change values or grads."""
+    q, k, v = _rand_qkv(jax.random.key(7), B=1, S=512, T=512, H=2, K=2,
+                        dh=32)
+    cot = jax.random.normal(jax.random.key(8), q.shape)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=True, sliding_window=64,
+                              block_q=64, block_kv=64)
+        return jnp.sum(out * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_oracle(q, k, v, window=64) * cot)
+
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v, causal=True, sliding_window=64,
+                                   block_q=64, block_kv=64)),
+        np.asarray(_oracle(q, k, v, window=64)), atol=2e-5, rtol=2e-5)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+
 @pytest.mark.parametrize("case", ["causal", "softcap", "window"])
 def test_grads_match_oracle(case):
     kw = CASES[case]
