@@ -1,0 +1,92 @@
+"""Mixtral-8x7B (47B-param MoE) traces end to end at abstract scale.
+
+Companion to tests/test_70b_shapes.py for the EP config
+(ray-jobs/fine_tune_config_mixtral.json: fsdp=4 x model=4 on v5e-16,
+QLoRA attention adapters): param specs divide the full 8-expert dims on
+an EP-enabled mesh, and the FULL QLoRA train step (router aux + frozen
+expert banks + adapter grads) traces via eval_shape without memory.
+"""
+
+import jax
+import numpy as np
+
+from gke_ray_train_tpu.models import init_params, mixtral_8x7b, param_specs
+from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+from gke_ray_train_tpu.parallel.sharding import tree_shardings
+from gke_ray_train_tpu.train import (
+    LoraConfig, make_optimizer, make_train_step, warmup_cosine_schedule)
+from gke_ray_train_tpu.train.lora import init_lora, lora_specs
+from gke_ray_train_tpu.train.step import TrainState
+
+
+def _cfg():
+    return mixtral_8x7b(dtype="bfloat16", param_dtype="bfloat16",
+                        attn_impl="xla")
+
+
+def _ep_mesh(devices):
+    # the job config's axis split scaled onto the 8 fake devices:
+    # fsdp=2 x model=4 (experts ride the model axis — 8 % 4 == 0)
+    return build_mesh(MeshConfig(data=1, fsdp=2, model=4, context=1),
+                      devices)
+
+
+def test_mixtral_param_shardings_divide(devices):
+    cfg = _cfg()
+    mesh = _ep_mesh(devices)
+    shapes = jax.eval_shape(lambda k: init_params(cfg, k),
+                            jax.random.key(0))
+    shardings = tree_shardings(mesh, param_specs(cfg))
+    checked = [0]
+
+    def check(sd, sh):
+        local = sh.shard_shape(sd.shape)   # raises if indivisible
+        assert all(l >= 1 for l in local)
+        checked[0] += 1
+
+    jax.tree.map(check, shapes, shardings)
+    assert checked[0] > 0
+    # total params ~46.7e9, active (router + top-2 experts) ~12.9e9
+    total = sum(np.prod(s.shape) for s in jax.tree.leaves(shapes))
+    assert 45e9 < total < 48e9, total
+    assert 12e9 < cfg.active_param_count() < 14e9
+    # the expert bank is [n_repeats, E, d, f] sharded over `model` (EP)
+    bank = shapes["blocks"][0]["w_gate"]
+    assert bank.shape == (32, 8, 4096, 14336)
+
+
+def test_mixtral_qlora_train_step_traces(devices):
+    """eval_shape of the full QLoRA step at real Mixtral dims: frozen
+    MoE base + attention-only adapters + router load-balance aux."""
+    cfg = _cfg()
+    mesh = _ep_mesh(devices)
+    lcfg = LoraConfig(r=64, alpha=16)
+    opt = make_optimizer(warmup_cosine_schedule(2e-4, 100))
+    step = make_train_step(cfg, opt, mesh=mesh, grad_accum=2,
+                           lora_cfg=lcfg, donate=False)
+
+    p_shapes = jax.eval_shape(lambda k: init_params(cfg, k),
+                              jax.random.key(0))
+    l_shapes = jax.eval_shape(lambda k: init_lora(cfg, lcfg, k),
+                              jax.random.key(1))
+    o_shapes = jax.eval_shape(opt.init, l_shapes)
+    state = TrainState(params=p_shapes, lora=l_shapes,
+                       opt_state=o_shapes,
+                       step=jax.ShapeDtypeStruct((), np.int32))
+    B, S = 4, 1024
+    batch = {
+        "inputs": jax.ShapeDtypeStruct((B, S), np.int32),
+        "targets": jax.ShapeDtypeStruct((B, S), np.int32),
+        "weights": jax.ShapeDtypeStruct((B, S), np.float32),
+    }
+    new_state, metrics = jax.eval_shape(step, state, batch)
+    assert metrics["loss"].shape == ()
+    # adapters train; the frozen base keeps its shapes untouched
+    assert new_state.lora is not None
+    assert new_state.params["blocks"][0]["w_gate"].shape == \
+        (32, 8, 4096, 14336)
+    # adapter shardings also divide on the EP mesh
+    for sd, sh in zip(jax.tree.leaves(l_shapes),
+                      jax.tree.leaves(tree_shardings(
+                          mesh, lora_specs(cfg, lcfg)))):
+        assert all(l >= 1 for l in sh.shard_shape(sd.shape))
