@@ -301,11 +301,13 @@ def test_pipeline_error_gates(pp_mesh):
                         rope=None, positions=None, segment_ids=None)
 
 
-def test_pipeline_context_parallel_ring_matches_plain():
+@pytest.mark.parametrize("virtual", [1, 2])
+def test_pipeline_context_parallel_ring_matches_plain(virtual):
     """PP x CP: ring attention over the context axis inside the
     pipelined stack (stage-folded batch spec through dispatch's
-    batch_axes) reproduces the plain forward."""
-    cfg = tiny_cfg(attn_impl="ring")
+    batch_axes) reproduces the plain forward — under both the shift
+    (virtual=1) and circular (virtual=2, vmapped stages) schedules."""
+    cfg = tiny_cfg(attn_impl="ring", pipe_virtual=virtual)
     params = init_params(cfg, jax.random.key(6))
     mesh = build_mesh(MeshConfig(data=1, fsdp=2, model=1, context=2,
                                  pipe=2))
@@ -320,10 +322,12 @@ def test_pipeline_context_parallel_ring_matches_plain():
                                rtol=2e-4, atol=2e-4)
 
 
-def test_pipeline_context_parallel_a2a_matches_plain():
+@pytest.mark.parametrize("virtual", [1, 2])
+def test_pipeline_context_parallel_a2a_matches_plain(virtual):
     """PP x CP via the all-to-all (Ulysses) strategy: head counts divide
-    the context axis, so a2a proper runs (not the ring fallback)."""
-    cfg = tiny_cfg(attn_impl="a2a")
+    the context axis, so a2a proper runs (not the ring fallback) —
+    under both the shift and circular schedules."""
+    cfg = tiny_cfg(attn_impl="a2a", pipe_virtual=virtual)
     params = init_params(cfg, jax.random.key(7))
     mesh = build_mesh(MeshConfig(data=1, fsdp=2, model=1, context=2,
                                  pipe=2))
