@@ -71,7 +71,7 @@ def _softcap_fwd(s, cap):
     return jnp.tanh(s / cap) * cap if cap is not None else s
 
 
-def _block_live(q_pos, kv_pos, causal, window):
+def _block_live(q_pos, kv_pos, q_seg, kv_seg, causal, window):
     """Block-level skip predicate shared by fwd/dq/dkv kernels.
 
     Dead block ⇔ no (q, kv) pair can be unmasked:
@@ -79,13 +79,23 @@ def _block_live(q_pos, kv_pos, causal, window):
     - window-expired past: every kv at or older than every q - window
       (mask keeps ``kv > q - window``, so max(kv) <= min(q) - window is
       provably all-masked — conservative under packed/per-segment
-      positions, since any in-window pair violates it).
+      positions, since any in-window pair violates it);
+    - segment-disjoint: the mask keeps only q_seg == kv_seg != 0, so
+      non-overlapping [min, max] segment-id ranges can contain no equal
+      pair (if max(q_seg) < min(kv_seg) or vice versa, every pair
+      differs). Packed rows number documents 1..N along the sequence,
+      making attention block-diagonal — with the causal skip this cuts
+      the scanned area from O(S²/2) toward O(Σ len(doc)²/2). An
+      all-padding (segment-0) block is disjoint from every real one and
+      skips too.
     Predicated-off blocks still DMA but skip the matmuls — on long
-    sliding-window sequences (Gemma-2 4k+) this cuts the scanned KV
-    area from O(S²/2) to O(S·window)."""
+    sliding-window sequences (Gemma-2 4k+) the window clause alone cuts
+    the scanned KV area from O(S²/2) to O(S·window)."""
     live = (not causal) or (jnp.max(q_pos) >= jnp.min(kv_pos))
     if window is not None:
         live = live & (jnp.max(kv_pos) > jnp.min(q_pos) - window)
+    live = live & (jnp.min(q_seg) <= jnp.max(kv_seg)) \
+        & (jnp.min(kv_seg) <= jnp.max(q_seg))
     return live
 
 
@@ -133,9 +143,10 @@ def _fwd_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    # block-level skip (causal future + window-expired past): see
-    # _block_live. DMA still happens, compute does not.
-    run = _block_live(q_pos, kv_pos, causal, window)
+    # block-level skip (causal future + window-expired past +
+    # segment-disjoint): see _block_live. DMA happens, compute does not.
+    run = _block_live(q_pos, kv_pos, qs_ref[0, 0], ks_ref[0, 0],
+                      causal, window)
 
     @pl.when(run)
     def _():
@@ -258,7 +269,8 @@ def _dq_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    run = _block_live(q_pos, kv_pos, causal, window)
+    run = _block_live(q_pos, kv_pos, qs_ref[0, 0], ks_ref[0, 0],
+                      causal, window)
 
     @pl.when(run)
     def _():
@@ -294,7 +306,8 @@ def _dkv_kernel(qp_ref, kp_ref, qs_ref, ks_ref, q_ref, k_ref, v_ref,
 
     q_pos = qp_ref[0, 0]
     kv_pos = kp_ref[0, 0]
-    run = _block_live(q_pos, kv_pos, causal, window)
+    run = _block_live(q_pos, kv_pos, qs_ref[0, 0], ks_ref[0, 0],
+                      causal, window)
 
     @pl.when(run)
     def _():

@@ -86,6 +86,46 @@ def test_packed_segments_and_padding():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_segment_disjoint_blocks_skipped_exactly():
+    """Packed rows with block-aligned documents: q blocks of doc 2 vs kv
+    blocks of doc 1 are causally LIVE but segment-dead — only the
+    segment-disjoint clause of _block_live skips them. Values and grads
+    must match the oracle exactly (plus an all-padding tail block)."""
+    B, S, H, K, dh = 1, 192, 2, 2, 32
+    q, k, v = _rand_qkv(jax.random.key(9), B, S, S, H, K, dh)
+    # doc1 = positions 0..63, doc2 = 64..127 (positions restart), padding
+    seg = jnp.concatenate([jnp.full((B, 64), 1), jnp.full((B, 64), 2),
+                           jnp.zeros((B, 64))], axis=1).astype(jnp.int32)
+    pos = jnp.concatenate([jnp.arange(64), jnp.arange(64),
+                           jnp.zeros(64)]).astype(jnp.int32)[None]
+    cot = jax.random.normal(jax.random.key(10), q.shape)
+
+    def flash(q, k, v):
+        return flash_attention(q, k, v, q_positions=pos, kv_positions=pos,
+                               q_segment_ids=seg, kv_segment_ids=seg,
+                               causal=True, block_q=32, block_kv=32)
+
+    def oracle(q, k, v):
+        mask = make_attention_mask(pos, pos, seg, seg, causal=True)
+        return dot_product_attention(q, k, v, mask)
+
+    real = np.asarray(seg != 0)[0]
+    out, ref = np.asarray(flash(q, k, v)), np.asarray(oracle(q, k, v))
+    np.testing.assert_allclose(out[:, real], ref[:, real],
+                               atol=2e-5, rtol=2e-5)
+
+    # grads: zero the padding rows' cotangent (oracle's uniform-softmax
+    # garbage there is "don't care" and loss-masked in real use)
+    mcot = cot * jnp.asarray(real)[None, :, None, None]
+    gf = jax.grad(lambda *a: jnp.sum(flash(*a) * mcot),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda *a: jnp.sum(oracle(*a) * mcot),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5)
+
+
 def test_window_expired_blocks_skipped_exactly():
     """Long sliding-window sequence where whole KV blocks are BOTH
     causally past and window-expired (S=512, window=64, 64-wide blocks:
