@@ -1,0 +1,24 @@
+"""Shared warn-once machinery.
+
+Trace-time fallback warnings (dense-mask attention fallback, dense
+prefill, shallow pipeline microbatches, unknown MFU roofline) must fire
+once per distinct shape/config key — not once per step, and not
+silently. One seen-set for the whole package so the pattern cannot
+drift per module (ADVICE-style reuse; was four private copies).
+"""
+
+from __future__ import annotations
+
+import logging
+
+_seen: set = set()
+
+
+def warn_once(logger: logging.Logger, key, msg: str, *args) -> None:
+    """Emit ``logger.warning(msg, *args)`` the first time ``key`` is
+    seen; subsequent calls with the same key are silent. Tests may clear
+    ``_seen`` (monkeypatch) to re-arm."""
+    if key in _seen:
+        return
+    _seen.add(key)
+    logger.warning(msg, *args)

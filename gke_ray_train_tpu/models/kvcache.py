@@ -18,6 +18,10 @@ TPU design:
   overwritten before they ever become visible.
 - Static shapes everywhere: the decode loop is a ``lax.while_loop``
   over a fixed buffer, one compile per (B, L, max_new) bucket.
+- Prefill runs through the flash kernel when the prompt and cache
+  widths tile by 128 (the dense path materializes [B, H, T, max_len]
+  logits — the O(S²) memory wall at long prompts); T = 1 decode steps
+  and ``attn_impl="xla"`` keep the dense mask.
 """
 
 from __future__ import annotations
@@ -65,6 +69,17 @@ def _scatter_rows(cache_kv: jnp.ndarray, new_kv: jnp.ndarray,
     return jax.vmap(upd)(cache_kv, new_kv.astype(cache_kv.dtype), lens)
 
 
+def _warn_dense_prefill(T: int, max_len: int) -> None:
+    import logging
+
+    from gke_ray_train_tpu.logging_utils import warn_once
+    warn_once(logging.getLogger(__name__), ("dense_prefill", T, max_len),
+              "prefill width %d / cache %d do not tile by 128 — falling "
+              "back to dense-mask attention (O(T*max_len) logits in "
+              "memory); pad the prompt buffer to 128-multiples to use "
+              "the flash kernel", T, max_len)
+
+
 def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
                  cache: Cache, lens: jnp.ndarray, *,
                  lora: Optional[Params] = None,
@@ -101,12 +116,26 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
 
     kv_positions = jnp.broadcast_to(
         jnp.arange(max_len, dtype=jnp.int32)[None, :], (B, max_len))
+    # prefill goes through the flash kernel when shapes tile (the dense
+    # path materializes [B, H, T, max_len] logits — the O(S²) memory
+    # wall at long prompts); single-token decode steps (T=1) and odd
+    # widths keep the cheap dense mask, and attn_impl="xla" forces it.
+    # ring/a2a are training-time context-parallel strategies — decode is
+    # mesh-local, so they resolve to plain flash here.
+    use_flash = (cfg.resolved_attn_impl != "xla" and T > 1
+                 and T % 128 == 0 and max_len % 128 == 0)
+    if not use_flash and cfg.resolved_attn_impl != "xla" and T > 1:
+        # loud fallback, same policy as transformer._warn_flash_fallback:
+        # a non-tiling long prefill silently eating O(T·max_len) logits
+        # memory is easy to miss (pad the prompt buffer to 128s instead)
+        _warn_dense_prefill(T, max_len)
     masks = {}
-    for kind in set(cfg.block_pattern):
-        masks[kind] = make_attention_mask(
-            positions, kv_positions, causal=True,
-            sliding_window=(cfg.sliding_window if kind == "sliding"
-                            else None))
+    if not use_flash:
+        for kind in set(cfg.block_pattern):
+            masks[kind] = make_attention_mask(
+                positions, kv_positions, causal=True,
+                sliding_window=(cfg.sliding_window if kind == "sliding"
+                                else None))
 
     def repeat_body(x, xs_slice):
         layer_slice = xs_slice[0]
@@ -133,10 +162,23 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
                 k = apply_rope(k, positions, rope)
             k_cache = _scatter_rows(ck["k"], k.astype(ck["k"].dtype), lens)
             v_cache = _scatter_rows(ck["v"], v.astype(ck["v"].dtype), lens)
-            out = dot_product_attention(
-                q, k_cache.astype(dtype), v_cache.astype(dtype),
-                masks[kind], scale=cfg.attn_scale,
-                logit_softcap=cfg.attn_softcap)
+            window = cfg.sliding_window if kind == "sliding" else None
+            if use_flash:
+                # single kernel entry point for the whole repo
+                # (ops/dispatch.py); mesh=None — decode is mesh-local
+                from gke_ray_train_tpu.ops.dispatch import (
+                    attention_dispatch)
+                out = attention_dispatch(
+                    "flash", q, k_cache.astype(dtype),
+                    v_cache.astype(dtype),
+                    q_positions=positions, kv_positions=kv_positions,
+                    causal=True, sliding_window=window,
+                    scale=cfg.attn_scale, logit_softcap=cfg.attn_softcap)
+            else:
+                out = dot_product_attention(
+                    q, k_cache.astype(dtype), v_cache.astype(dtype),
+                    masks[kind], scale=cfg.attn_scale,
+                    logit_softcap=cfg.attn_softcap)
             h = _proj(out.reshape(B, T, H * hd), lp["wo"], lr("wo"),
                       lora_scale, dtype)
             if cfg.post_block_norm:
@@ -187,9 +229,20 @@ def greedy_generate_cached(params: Params, prompt: jnp.ndarray,
 
     prompt: [B, L] right-padded buffer with L >= prompt_len + max_new;
     the prompt region (L - max_new_tokens) is prefilled in one pass.
+
+    The prefill width is rounded UP to a 128 multiple (capped at L) so
+    the flash-prefill gate engages for any max_new_tokens. Safe by the
+    same invariant right-padding already relies on: garbage K/V written
+    past prompt_len sit at positions strictly above every query's until
+    the decode loop overwrites them (one slot per step, always writing
+    slot ``lens`` before attending), so they are never unmasked.
     """
     B, L = prompt.shape
     Lp = max(L - max_new_tokens, 1)
+    if L % 128 == 0 and Lp > 1:
+        # only when the flash gate can actually engage (max_len = L must
+        # tile too) — otherwise rounding just widens the dense prefill
+        Lp = min(L, ((Lp + 127) // 128) * 128)
     eos = jnp.asarray(list(eos_ids) or [-1], jnp.int32)
 
     cache = init_cache(cfg, B, L)

@@ -96,19 +96,15 @@ from gke_ray_train_tpu.parallel.mesh import (
 # the folded (stage * microbatch) leading dim of attention inputs
 STAGE_BATCH_AXES = (AXIS_PIPE,) + BATCH_AXES
 
-_warned_shallow = set()
-
-
 def _warn_shallow_microbatches(M: int, V: int, Pn: int) -> None:
     """Trace-time (once per shape) warning: fewer microbatches than
     pipeline hops means the garbage fraction exceeds 50%."""
-    key = (M, V, Pn)
-    if key in _warned_shallow:
-        return
-    _warned_shallow.add(key)
     import logging
+
+    from gke_ray_train_tpu.logging_utils import warn_once
     depth = V * Pn
-    logging.getLogger(__name__).warning(
+    warn_once(
+        logging.getLogger(__name__), ("shallow_microbatches", M, V, Pn),
         "pipeline has %d microbatches for depth %d (pipe=%d x virtual=%d):"
         " garbage fraction is %d/%d — raise PIPE_MICROBATCHES to amortize",
         M, depth, Pn, V, depth - 1, M + depth - 1)

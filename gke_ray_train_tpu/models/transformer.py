@@ -35,17 +35,13 @@ from gke_ray_train_tpu.parallel.mesh import AXIS_CONTEXT, BATCH_AXES
 Params = Dict[str, Any]
 
 logger = logging.getLogger(__name__)
-_flash_fallback_warned: set = set()
-
-
 def _warn_flash_fallback(seq_len: int) -> None:
     """Once per sequence length (trace-time, not per step)."""
-    if seq_len not in _flash_fallback_warned:
-        _flash_fallback_warned.add(seq_len)
-        logger.warning(
-            "attn_impl='flash' but seq_len=%d is not a 128 multiple — "
-            "falling back to the O(S^2) dense-mask XLA path; pad the "
-            "sequence to a 128 multiple to keep the kernel", seq_len)
+    from gke_ray_train_tpu.logging_utils import warn_once
+    warn_once(logger, ("flash_fallback", seq_len),
+              "attn_impl='flash' but seq_len=%d is not a 128 multiple — "
+              "falling back to the O(S^2) dense-mask XLA path; pad the "
+              "sequence to a 128 multiple to keep the kernel", seq_len)
 
 
 # ---------------------------------------------------------------------------

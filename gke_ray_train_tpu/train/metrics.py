@@ -44,9 +44,6 @@ PEAK_FLOPS = {
 }
 
 
-_warned_unknown_kind: set = set()
-
-
 def peak_flops_per_device(default: float = 197e12) -> float:
     kind = jax.devices()[0].device_kind.lower()
     for k, v in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
@@ -55,13 +52,13 @@ def peak_flops_per_device(default: float = 197e12) -> float:
     # an unrecognized device_kind (a future "TPU v7 lite", a GPU) would
     # silently misreport MFU against the default roofline — say so once
     # (VERDICT r4 weak #7)
-    if kind not in _warned_unknown_kind:
-        _warned_unknown_kind.add(kind)
-        import logging
-        logging.getLogger(__name__).warning(
-            "device_kind %r matches no PEAK_FLOPS entry; MFU uses the "
-            "default %.0f TFLOP/s roofline and may be wrong — extend "
-            "PEAK_FLOPS in %s", kind, default / 1e12, __name__)
+    import logging
+
+    from gke_ray_train_tpu.logging_utils import warn_once
+    warn_once(logging.getLogger(__name__), ("peak_flops", kind),
+              "device_kind %r matches no PEAK_FLOPS entry; MFU uses the "
+              "default %.0f TFLOP/s roofline and may be wrong — extend "
+              "PEAK_FLOPS in %s", kind, default / 1e12, __name__)
     return default
 
 

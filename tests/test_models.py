@@ -196,8 +196,8 @@ def test_flash_fallback_warns_once(caplog):
     """ADVICE r1: the flash->dense fallback for non-128-multiple seq
     lengths must warn (once per length), not silently lose the kernel."""
     import logging
-    from gke_ray_train_tpu.models.transformer import _flash_fallback_warned
-    _flash_fallback_warned.clear()
+    from gke_ray_train_tpu.logging_utils import _seen
+    _seen.clear()
     cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
                n_kv_heads=2, d_ff=64, dtype="float32",
                param_dtype="float32", attn_impl="flash")

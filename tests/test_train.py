@@ -240,8 +240,9 @@ def test_peak_flops_unknown_device_warns_once(caplog, monkeypatch):
     class FakeDev:
         device_kind = "TPU v9 mega"
 
+    from gke_ray_train_tpu import logging_utils
     monkeypatch.setattr(M.jax, "devices", lambda: [FakeDev()])
-    monkeypatch.setattr(M, "_warned_unknown_kind", set())
+    monkeypatch.setattr(logging_utils, "_seen", set())
     with caplog.at_level("WARNING", logger=M.__name__):
         assert M.peak_flops_per_device() == 197e12
     assert any("PEAK_FLOPS" in r.getMessage() for r in caplog.records)
