@@ -93,7 +93,12 @@ def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
     max_prompt = max(cfg.max_seq_len - max_new_tokens, 1)
     if len(ids) > max_prompt:
         ids = ids[-max_prompt:]
-    L = min(_prompt_bucket(len(ids)) + max_new_tokens, cfg.max_seq_len)
+    # buffer width rounded to a 128 multiple: the KV-cache flash prefill
+    # gates on the CACHE width tiling too (models/kvcache.py) — an
+    # unaligned width would silently fall back to the dense
+    # O(T*max_len) prefill at exactly the long-prompt sizes where it
+    # hurts. One bucket call keeps compile-sharing per length class.
+    L = min(_prompt_bucket(len(ids) + max_new_tokens), cfg.max_seq_len)
     buf = np.zeros((1, L), np.int32)
     buf[0, :len(ids)] = ids
     eos_ids = []
@@ -117,11 +122,16 @@ def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
             lora=lora, lora_scale=lora_scale)
         out = np.asarray(out[0])
     gen = out[len(ids):]
-    gen = gen[gen != 0]
-    if eos_ids:
-        stops = np.where(np.isin(gen, eos_ids))[0]
-        if len(stops):
-            gen = gen[: stops[0]]
+    # trim at the first EOS; otherwise strip only TRAILING zeros (the
+    # unwritten buffer tail). Filtering every zero would also delete a
+    # legitimately generated token id 0 (e.g. "!" in Llama-3's vocab)
+    # from the middle of the answer.
+    stops = np.where(np.isin(gen, eos_ids))[0] if eos_ids else []
+    if len(stops):
+        gen = gen[: stops[0]]
+    else:
+        nz = np.nonzero(gen)[0]
+        gen = gen[: nz[-1] + 1] if len(nz) else gen[:0]
     return tokenizer.decode(gen)
 
 
