@@ -43,6 +43,10 @@ def _hf_layer_names(cfg: ModelConfig, i: int) -> Dict[str, str]:
         "wo": f"{base}.self_attn.o_proj.weight",
         "attn_norm": f"{base}.input_layernorm.weight",
     }
+    if cfg.attn_qkv_bias:  # Qwen-2 layout
+        names["bq"] = f"{base}.self_attn.q_proj.bias"
+        names["bk"] = f"{base}.self_attn.k_proj.bias"
+        names["bv"] = f"{base}.self_attn.v_proj.bias"
     if cfg.n_experts > 0:  # Mixtral layout
         names["router"] = f"{base}.block_sparse_moe.gate.weight"
     else:

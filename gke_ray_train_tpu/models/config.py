@@ -63,6 +63,7 @@ class ModelConfig:
 
     tie_embeddings: bool = False
     embed_scale: bool = False               # x *= sqrt(d_model) after embed
+    attn_qkv_bias: bool = False             # Qwen-2: bias on q/k/v proj only
     norm_scale_plus_one: bool = False       # Gemma (1 + scale) RMSNorm
     post_block_norm: bool = False           # Gemma-2 post-attn/post-mlp norms
     attn_softcap: Optional[float] = None    # Gemma-2: 50.0
@@ -162,6 +163,8 @@ class ModelConfig:
         attn = (self.d_model * self.n_heads * hd          # wq
                 + 2 * self.d_model * self.n_kv_heads * hd  # wk, wv
                 + self.n_heads * hd * self.d_model)        # wo
+        if self.attn_qkv_bias:
+            attn += self.n_heads * hd + 2 * self.n_kv_heads * hd
         ffn = 3 * self.d_model * self.d_ff
         if self.n_experts:
             mlp = (self.d_model * self.n_experts          # router
@@ -224,6 +227,17 @@ def mixtral_8x7b(**kw) -> ModelConfig:
         **kw)
 
 
+def qwen2_7b(**kw) -> ModelConfig:
+    """Qwen-2/2.5 7B: Llama-style GQA decoder whose one architectural
+    delta is bias on the q/k/v projections (public architecture; the HF
+    checkpoints carry q_proj.bias etc.)."""
+    return ModelConfig(
+        name="qwen2-7b", vocab_size=152064, d_model=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, d_ff=18944, max_seq_len=32768,
+        rope_theta=1e6, attn_qkv_bias=True, norm_eps=1e-6,
+        **kw)
+
+
 def gemma2_9b(**kw) -> ModelConfig:
     return ModelConfig(
         name="gemma2-9b", vocab_size=256128, d_model=3584, n_layers=42,
@@ -266,6 +280,7 @@ PRESETS = {
     "mistral-7b": mistral_7b,
     "mixtral-8x7b": mixtral_8x7b,
     "gemma2-9b": gemma2_9b,
+    "qwen2-7b": qwen2_7b,
 }
 
 
@@ -287,5 +302,7 @@ def preset_for_model_id(model_id: str, **kw) -> ModelConfig:
         return mistral_7b(**kw)
     if "gemma-2" in mid or "gemma2" in mid:
         return gemma2_9b(**kw)
+    if "qwen" in mid:
+        return qwen2_7b(**kw)
     raise ValueError(f"no preset for MODEL_ID={model_id!r}; "
                      f"known families: {sorted(PRESETS)}")

@@ -151,9 +151,12 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
                 return _lora_entry(lo, name)
 
             h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
-            q = _proj(h, lp["wq"], lr("wq"), lora_scale, dtype)
-            k = _proj(h, lp["wk"], lr("wk"), lora_scale, dtype)
-            v = _proj(h, lp["wv"], lr("wv"), lora_scale, dtype)
+            q = _proj(h, lp["wq"], lr("wq"), lora_scale, dtype,
+                      bias=lp.get("bq"))
+            k = _proj(h, lp["wk"], lr("wk"), lora_scale, dtype,
+                      bias=lp.get("bk"))
+            v = _proj(h, lp["wv"], lr("wv"), lora_scale, dtype,
+                      bias=lp.get("bv"))
             q = q.reshape(B, T, H, hd)
             k = k.reshape(B, T, K, hd)
             v = v.reshape(B, T, K, hd)

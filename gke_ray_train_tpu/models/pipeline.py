@@ -116,8 +116,9 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def _proj_p(x, w, lora_p, lora_scale, dtype):
-    """Stage-batched projection: x [P, Bm, S, d_in] @ w [P, d_in, d_out].
+def _proj_p(x, w, lora_p, lora_scale, dtype, bias=None):
+    """Stage-batched projection: x [P, Bm, S, d_in] @ w [P, d_in, d_out]
+    (+ optional per-stage bias [P, d_out] — Qwen-2 q/k/v).
 
     One matmul per stage (block-diagonal to XLA — each device sees only
     its own stage's operand, so locally this is a plain matmul on the
@@ -129,6 +130,8 @@ def _proj_p(x, w, lora_p, lora_scale, dtype):
         y = y + jnp.einsum("pbsr,prh->pbsh", xa,
                            lora_p["b"].astype(dtype)) \
             * jnp.asarray(lora_scale, dtype)
+    if bias is not None:
+        y = y + bias[:, None, None, :].astype(dtype)
     return y
 
 
@@ -151,9 +154,12 @@ def _attn_p(x, lp, cfg: ModelConfig, impl, dtype, rope, posf, segf, mask,
 
     def lr(name):
         return _lora_entry(lora_p, name)
-    q = _proj_p(x, lp["wq"], lr("wq"), lora_scale, dtype)
-    k = _proj_p(x, lp["wk"], lr("wk"), lora_scale, dtype)
-    v = _proj_p(x, lp["wv"], lr("wv"), lora_scale, dtype)
+    q = _proj_p(x, lp["wq"], lr("wq"), lora_scale, dtype,
+                bias=lp.get("bq"))
+    k = _proj_p(x, lp["wk"], lr("wk"), lora_scale, dtype,
+                bias=lp.get("bk"))
+    v = _proj_p(x, lp["wv"], lr("wv"), lora_scale, dtype,
+                bias=lp.get("bv"))
     # fold the stage dim into batch: attention is weightless, so every
     # stage runs the identical kernel on its own microbatch
     q = q.reshape(Pn * Bm, S, H, hd)

@@ -134,6 +134,14 @@ def init_quantized_params(cfg: ModelConfig, key: jax.Array, *,
             for name in ("attn_post_norm", "mlp_post_norm"):
                 out[name] = _dense_leaf(norm_maker((R, D)),
                                         sharding_for(bspec[name]))
+        if cfg.attn_qkv_bias:
+            # Qwen-2 q/k/v biases: zero-init, full precision (never a
+            # quant target), same leaves init_params creates
+            for name, dim in (("bq", H * hd), ("bk", K * hd),
+                              ("bv", K * hd)):
+                out[name] = _dense_leaf(
+                    lambda dim=dim: jnp.zeros((R, dim), pdt),
+                    sharding_for(bspec[name]))
         for name, (shape, s) in proj_shapes.items():
             k = next(keys)
             if name in targets:

@@ -81,6 +81,12 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> Params:
             "mlp_norm": jnp.zeros((R, D), pdt) if cfg.norm_scale_plus_one
             else jnp.ones((R, D), pdt),
         }
+        if cfg.attn_qkv_bias:
+            # Qwen-2: bias on q/k/v only (o_proj stays bias-free);
+            # zero-init — real values come from the HF checkpoint
+            p["bq"] = jnp.zeros((R, H * hd), pdt)
+            p["bk"] = jnp.zeros((R, K * hd), pdt)
+            p["bv"] = jnp.zeros((R, K * hd), pdt)
         if E:
             # MoE MLP (ops/moe.py): router + expert bank, expert dim
             # sharded over `model` (expert parallelism, SURVEY.md EP row)
@@ -127,6 +133,11 @@ def param_specs(cfg: ModelConfig) -> Params:
             "wo": P("pipe", "model", "fsdp"),
             "mlp_norm": P("pipe", None),
         }
+        if cfg.attn_qkv_bias:
+            # bias vectors follow their projection's OUTPUT dim sharding
+            s["bq"] = P("pipe", "model")
+            s["bk"] = P("pipe", "model")
+            s["bv"] = P("pipe", "model")
         if cfg.n_experts:
             # expert dim over `model` = EP; GSPMD derives the token
             # all-to-alls from the dispatch einsums (ops/moe.py)
@@ -163,8 +174,10 @@ def _constrain(x, mesh: Optional[Mesh], *spec):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))
 
 
-def _proj(x, w, lora_p, lora_scale, dtype, drop_rng=None, drop_rate=0.0):
-    """x @ w, plus the low-rank LoRA bypass when adapters are present.
+def _proj(x, w, lora_p, lora_scale, dtype, drop_rng=None, drop_rate=0.0,
+          bias=None):
+    """x @ w (+ bias), plus the low-rank LoRA bypass when adapters are
+    present. ``bias``: optional [d_out] projection bias (Qwen-2 q/k/v).
 
     The LoRA path is two small matmuls (never a materialized delta-W) —
     the TPU-native replacement for peft's adapter modules (reference:
@@ -189,6 +202,8 @@ def _proj(x, w, lora_p, lora_scale, dtype, drop_rng=None, drop_rate=0.0):
         xa = jnp.einsum("bsd,dr->bsr", xl, lora_p["a"].astype(dtype))
         y = y + jnp.einsum("bsr,rh->bsh", xa, lora_p["b"].astype(dtype)) \
             * jnp.asarray(lora_scale, dtype)
+    if bias is not None:
+        y = y + bias.astype(dtype)
     return y
 
 
@@ -228,11 +243,11 @@ def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
     def lr(name):
         return _lora_entry(lora_p, name)
     q = _proj(x, lp["wq"], lr("wq"), lora_scale, dtype,
-              _drop_key(drop_rng, 0), drop_rate)
+              _drop_key(drop_rng, 0), drop_rate, bias=lp.get("bq"))
     k = _proj(x, lp["wk"], lr("wk"), lora_scale, dtype,
-              _drop_key(drop_rng, 1), drop_rate)
+              _drop_key(drop_rng, 1), drop_rate, bias=lp.get("bk"))
     v = _proj(x, lp["wv"], lr("wv"), lora_scale, dtype,
-              _drop_key(drop_rng, 2), drop_rate)
+              _drop_key(drop_rng, 2), drop_rate, bias=lp.get("bv"))
     q = q.reshape(B, S, H, hd)
     k = k.reshape(B, S, K, hd)
     v = v.reshape(B, S, K, hd)

@@ -33,14 +33,28 @@ def warmup_cosine_schedule(base_lr: float, total_steps: int, *,
     )
 
 
+# leaves AdamW must not decay: norm scales and projection biases. Keyed
+# by NAME because the stacked block layout makes norm scales [R, D] and
+# biases [R, dim] — an ndim>=2 test wrongly classified them as matrices
+# (the pre-r5 mask decayed stacked norm scales despite its docstring).
+_NO_DECAY_KEYS = frozenset({
+    "attn_norm", "mlp_norm", "attn_post_norm", "mlp_post_norm",
+    "final_norm", "bq", "bk", "bv"})
+
+
 def default_weight_decay_mask(params: Any) -> Any:
-    """Decay only matrices — norm scales and other vectors are excluded.
+    """Decay only weight matrices — norm scales and biases are excluded.
 
     (Deviation from the reference, which lets torch AdamW decay
     everything; decaying RMSNorm scales toward zero is simply wrong for
     pre-LN transformers, so we fix it rather than port it.)
     """
-    return jax.tree.map(lambda p: p.ndim >= 2, params)
+    def decay(path, p):
+        key = next((e.key for e in reversed(path) if hasattr(e, "key")),
+                   None)
+        return key not in _NO_DECAY_KEYS and p.ndim >= 2
+
+    return jax.tree_util.tree_map_with_path(decay, params)
 
 
 def make_optimizer(schedule: optax.Schedule | float, *,

@@ -1,0 +1,141 @@
+"""Qwen-2 family: q/k/v projection bias through every forward path.
+
+The one architectural delta vs Llama (public Qwen-2 architecture; HF
+checkpoints carry q_proj.bias etc.). These tests pin: bias-at-zero
+equals the bias-free model, nonzero bias agrees across the plain
+forward, the pipelined forward, and the KV-cache decode, HF interop
+round-trips the bias tensors, and the full train step updates them.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gke_ray_train_tpu.ckpt import load_hf_checkpoint, save_hf_checkpoint
+from gke_ray_train_tpu.models import (
+    forward, greedy_generate, greedy_generate_cached, init_params,
+    param_specs, preset_for_model_id, qwen2_7b, tiny)
+from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+from gke_ray_train_tpu.parallel.sharding import shard_tree
+
+
+def qwen_tiny(**kw):
+    return tiny(vocab_size=128, d_model=64, n_layers=4, n_heads=4,
+                n_kv_heads=2, d_ff=128, attn_qkv_bias=True, **kw)
+
+
+def biased_params(cfg, seed=0):
+    """init + NONZERO biases (zero-init would make the feature vacuous)."""
+    params = init_params(cfg, jax.random.key(seed))
+    rng = np.random.default_rng(seed + 1)
+    for blk in params["blocks"]:
+        for b in ("bq", "bk", "bv"):
+            blk[b] = jnp.asarray(
+                rng.normal(0, 0.5, blk[b].shape), blk[b].dtype)
+    return params
+
+
+def test_preset_and_matcher():
+    cfg = preset_for_model_id("Qwen/Qwen2.5-7B-Instruct")
+    assert cfg.name == "qwen2-7b" and cfg.attn_qkv_bias
+    assert cfg.n_heads == 28 and cfg.n_kv_heads == 4
+    # ~7.6B params, biases included in the exact count
+    assert 7.0e9 < qwen2_7b().param_count() < 8.0e9
+
+
+def test_zero_bias_equals_biasless_model():
+    cfg_b = qwen_tiny()
+    cfg_n = dataclasses.replace(cfg_b, attn_qkv_bias=False)
+    params_b = init_params(cfg_b, jax.random.key(0))  # biases zero-init
+    params_n = {
+        **params_b,
+        "blocks": [{k: v for k, v in blk.items()
+                    if k not in ("bq", "bk", "bv")}
+                   for blk in params_b["blocks"]],
+    }
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    np.testing.assert_array_equal(
+        np.asarray(forward(params_b, tokens, cfg_b)),
+        np.asarray(forward(params_n, tokens, cfg_n)))
+
+
+def test_bias_agrees_across_all_forward_paths():
+    """Nonzero bias must change the logits AND produce identical results
+    from the plain scan, the pipelined stack, and the KV-cache prefill."""
+    cfg = qwen_tiny()
+    params = biased_params(cfg)
+    tokens = jax.random.randint(jax.random.key(2), (16, 32), 0, 128)
+
+    ref = forward(params, tokens, cfg)
+    # bias has teeth: zeroing it changes the output
+    zeroed = {
+        **params,
+        "blocks": [{k: (jnp.zeros_like(v) if k in ("bq", "bk", "bv")
+                        else v) for k, v in blk.items()}
+                   for blk in params["blocks"]],
+    }
+    assert float(jnp.max(jnp.abs(
+        forward(zeroed, tokens, cfg) - ref))) > 1e-3
+
+    # pipelined path (shift and circular schedules)
+    mesh = build_mesh(MeshConfig(data=2, fsdp=2, model=1, context=1,
+                                 pipe=2))
+    sharded = shard_tree(params, mesh, param_specs(cfg))
+    for virtual in (1, 2):
+        vcfg = dataclasses.replace(cfg, pipe_virtual=virtual)
+        got = jax.jit(lambda p, t, c=vcfg: forward(p, t, c, mesh=mesh))(
+            sharded, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    # KV-cache decode vs the full-recompute oracle
+    prompt, lens = tokens[:2, :24], jnp.full((2,), 20, jnp.int32)
+    want = greedy_generate(params, prompt, lens, cfg, max_new_tokens=4)
+    got = greedy_generate_cached(params, prompt, lens, cfg,
+                                 max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_hf_roundtrip_with_bias(tmp_path):
+    cfg = qwen_tiny()
+    params = biased_params(cfg, seed=3)
+    save_hf_checkpoint(params, cfg, str(tmp_path / "hf"), dtype="float32")
+    # the HF tensor names a Qwen checkpoint actually uses
+    from safetensors import safe_open
+    import glob
+    names = set()
+    for f in glob.glob(str(tmp_path / "hf" / "*.safetensors")):
+        with safe_open(f, framework="np") as fh:
+            names |= set(fh.keys())
+    assert "model.layers.0.self_attn.q_proj.bias" in names
+    assert "model.layers.3.self_attn.v_proj.bias" in names
+
+    loaded = load_hf_checkpoint(str(tmp_path / "hf"), cfg)
+    tokens = jax.random.randint(jax.random.key(4), (2, 16), 0, 128)
+    np.testing.assert_allclose(
+        np.asarray(forward(loaded, tokens, cfg)),
+        np.asarray(forward(params, tokens, cfg)), rtol=1e-5, atol=1e-5)
+
+
+def test_train_step_updates_biases(fsdp_mesh):
+    """Full sharded train step: bias leaves get gradients and move."""
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    cfg = qwen_tiny(remat=True)
+    opt = make_optimizer(1e-2)  # constant lr: warmup step 0 is lr=0
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=fsdp_mesh)
+    step = make_train_step(cfg, opt, mesh=fsdp_mesh, grad_accum=2)
+    rng = np.random.default_rng(5)
+    batch = {
+        "inputs": rng.integers(0, 128, (8, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (8, 16)).astype(np.int32),
+        "weights": np.ones((8, 16), np.float32),
+    }
+    before = np.asarray(state.params["blocks"][0]["bq"])
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(jax.device_get(metrics["loss"])))
+    after = np.asarray(state.params["blocks"][0]["bq"])
+    assert np.any(np.abs(after - before) > 0)
