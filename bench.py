@@ -20,7 +20,8 @@ Mistral-7B dims, sliding-window attention, NF4 base + LoRA),
 ``gemma2-4k`` (BASELINE config 5 shape: Gemma-2 pattern — alternating
 sliding/global, softcaps, tied embeddings — packed seq 4096),
 ``seq4k`` (packed 4k llama-proxy), ``moe`` (Mixtral-pattern 8-expert
-top-2 MoE proxy), ``decode`` (KV-cache greedy decode tokens/sec).
+top-2 MoE proxy), ``qwen2-lora`` (full Qwen-2.5-7B dims incl. q/k/v
+bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec).
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -48,7 +49,7 @@ import jax.numpy as jnp
 # with dots: 22.1 GB requested) and the packed-4k gemma mode, whose
 # seq-4096 activations are the problem (dots: 19.2 GB requested).
 _REMAT_DEFAULTS = {"qlora8b": "full", "mistral7b-lora": "full",
-                   "gemma2-4k": "full"}
+                   "qwen2-lora": "full", "gemma2-4k": "full"}
 BENCH_REMAT_POLICY = os.environ.get("BENCH_REMAT") or _REMAT_DEFAULTS.get(
     os.environ.get("BENCH_MODE", "train"), "dots")
 if BENCH_REMAT_POLICY not in ("full", "dots"):
@@ -225,46 +226,56 @@ def _bench_qlora_family(cfg, label, *, B, S, steps, lora_r=64):
         compare_baseline=False)
 
 
+def _bench_lora_mode(preset_fn, name, label, tiny_overrides=None):
+    """Shared scaffold for the full-family-dims NF4+LoRA modes: one
+    protocol (seq 1024, B=4, 10 steps, bf16 leaves) so family rows stay
+    comparable. ``tiny_overrides`` = pattern-faithful CPU-fallback dims
+    (None = TPU-only mode; the flagship qlora8b shape has no meaningful
+    CPU proxy)."""
+    import dataclasses
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    common = dict(name=name, dtype="bfloat16", param_dtype="bfloat16",
+                  remat=True, remat_policy=BENCH_REMAT_POLICY)
+    if on_tpu or tiny_overrides is None:
+        cfg = dataclasses.replace(preset_fn(), max_seq_len=1024, **common)
+        B, S, steps = 4, 1024, 10
+    else:
+        cfg = dataclasses.replace(preset_fn(), **common, **tiny_overrides)
+        B, S, steps = 2, 256, 2
+    _bench_qlora_family(cfg, label, B=B, S=S, steps=steps)
+
+
+_TINY_LORA_DIMS = dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                       d_ff=512, vocab_size=2048, max_seq_len=256)
+
+
 def bench_qlora8b():
     """Flagship size on one chip: Llama-3.1-8B dims, NF4 frozen base,
     r=64 LoRA adapters trained (the reference's exact QLoRA workload,
     fine_tune_config.json)."""
-    import dataclasses
-
     from gke_ray_train_tpu.models import llama3_8b
-
-    cfg = dataclasses.replace(
-        llama3_8b(), name="llama3-8b-qlora-bench", max_seq_len=1024,
-        dtype="bfloat16", param_dtype="bfloat16", remat=True,
-        remat_policy=BENCH_REMAT_POLICY)
-    _bench_qlora_family(cfg, "Llama-3.1-8B QLoRA", B=4, S=1024, steps=10)
+    _bench_lora_mode(llama3_8b, "llama3-8b-qlora-bench",
+                     "Llama-3.1-8B QLoRA")
 
 
 def bench_mistral7b_lora():
     """BASELINE config 4: Mistral-7B dims (sliding-window attention
     pattern) + LoRA adapters over an NF4 frozen base — the PEFT
-    fine-tune shape at full family size on one chip. CPU fallback runs
-    pattern-faithful tiny dims so the mode stays testable."""
-    import dataclasses
-
+    fine-tune shape at full family size on one chip."""
     from gke_ray_train_tpu.models import mistral_7b
+    _bench_lora_mode(mistral_7b, "mistral7b-lora-bench",
+                     "Mistral-7B LoRA",
+                     tiny_overrides=dict(_TINY_LORA_DIMS,
+                                         sliding_window=128))
 
-    on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
-        cfg = dataclasses.replace(
-            mistral_7b(), name="mistral7b-lora-bench", max_seq_len=1024,
-            dtype="bfloat16", param_dtype="bfloat16", remat=True,
-            remat_policy=BENCH_REMAT_POLICY)
-        B, S, steps = 4, 1024, 10
-    else:
-        cfg = dataclasses.replace(
-            mistral_7b(), name="mistral7b-lora-bench", d_model=256,
-            n_layers=2, n_heads=4, n_kv_heads=2, d_ff=512,
-            vocab_size=2048, max_seq_len=256, sliding_window=128,
-            dtype="bfloat16", param_dtype="bfloat16", remat=True,
-            remat_policy=BENCH_REMAT_POLICY)
-        B, S, steps = 2, 256, 2
-    _bench_qlora_family(cfg, "Mistral-7B LoRA", B=B, S=S, steps=steps)
+
+def bench_qwen2_lora():
+    """Qwen-2.5-7B dims (q/k/v projection bias) + LoRA over an NF4
+    frozen base — same shape protocol as the Mistral row."""
+    from gke_ray_train_tpu.models import qwen2_7b
+    _bench_lora_mode(qwen2_7b, "qwen2-lora-bench", "Qwen-2.5-7B LoRA",
+                     tiny_overrides=dict(_TINY_LORA_DIMS))
 
 
 def bench_gemma2_4k():
@@ -508,6 +519,7 @@ def main():
      "mistral7b-lora": bench_mistral7b_lora,
      "gemma2-4k": bench_gemma2_4k,
      "seq4k": bench_seq4k, "moe": bench_moe,
+     "qwen2-lora": bench_qwen2_lora,
      "decode": bench_decode}[mode]()
 
 

@@ -31,6 +31,7 @@ run mistral7b-lora env BENCH_MODE=mistral7b-lora python bench.py
 run gemma2-4k      env BENCH_MODE=gemma2-4k python bench.py
 run seq4k          env BENCH_MODE=seq4k python bench.py
 run moe            env BENCH_MODE=moe python bench.py
+run qwen2-lora     env BENCH_MODE=qwen2-lora python bench.py
 run decode         env BENCH_MODE=decode python bench.py
 
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
