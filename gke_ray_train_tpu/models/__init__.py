@@ -1,5 +1,5 @@
 from gke_ray_train_tpu.models.config import (  # noqa: F401
-    ModelConfig, llama3_8b, llama3_70b, mistral_7b, mixtral_8x7b,
+    ModelConfig, llama2_7b, llama2_13b, llama2_70b, llama3_8b, llama3_70b, mistral_7b, mixtral_8x7b,
     gemma2_9b, qwen2_7b, basic_lm, tiny, PRESETS, preset_for_model_id)
 from gke_ray_train_tpu.models.transformer import (  # noqa: F401
     init_params, param_specs, forward)

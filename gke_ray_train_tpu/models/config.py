@@ -188,6 +188,34 @@ _LLAMA31_SCALING = dict(factor=8.0, low_freq_factor=1.0, high_freq_factor=4.0,
                         original_max_position_embeddings=8192)
 
 
+def llama2_7b(**kw) -> ModelConfig:
+    """Llama-2-7B: MHA (no GQA), rope theta 1e4, 32k vocab — runs on
+    the same decoder core with zero new mechanisms; HF tensor names are
+    identical to Llama-3's, so interop needs nothing new either."""
+    return ModelConfig(
+        name="llama2-7b", vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=32, d_ff=11008, max_seq_len=4096,
+        rope_theta=10000.0,
+        **kw)
+
+
+def llama2_13b(**kw) -> ModelConfig:
+    return ModelConfig(
+        name="llama2-13b", vocab_size=32000, d_model=5120, n_layers=40,
+        n_heads=40, n_kv_heads=40, d_ff=13824, max_seq_len=4096,
+        rope_theta=10000.0,
+        **kw)
+
+
+def llama2_70b(**kw) -> ModelConfig:
+    # the one GQA member of the Llama-2 family (n_kv_heads = 8)
+    return ModelConfig(
+        name="llama2-70b", vocab_size=32000, d_model=8192, n_layers=80,
+        n_heads=64, n_kv_heads=8, d_ff=28672, max_seq_len=4096,
+        rope_theta=10000.0,
+        **kw)
+
+
 def llama3_8b(**kw) -> ModelConfig:
     kw.setdefault("rope_scaling", _LLAMA31_SCALING)
     return ModelConfig(
@@ -275,6 +303,9 @@ def tiny(vocab_size: int = 256, **kw) -> ModelConfig:
 
 
 PRESETS = {
+    "llama2-7b": llama2_7b,
+    "llama2-13b": llama2_13b,
+    "llama2-70b": llama2_70b,
     "llama3-8b": llama3_8b,
     "llama3-70b": llama3_70b,
     "mistral-7b": mistral_7b,
@@ -288,6 +319,12 @@ def preset_for_model_id(model_id: str, **kw) -> ModelConfig:
     """Map an HF-style MODEL_ID (fine_tune_config.json key) to a preset."""
     mid = model_id.lower()
     is_31 = any(t in mid for t in ("llama-3.1", "llama-3_1", "llama3.1"))
+    if "llama-2" in mid or "llama2" in mid:
+        if "70b" in mid:
+            return llama2_70b(**kw)
+        if "13b" in mid:
+            return llama2_13b(**kw)
+        return llama2_7b(**kw)
     if "llama-3" in mid or "llama3" in mid:
         fn = llama3_70b if "70b" in mid else llama3_8b
         # NTK rope scaling is a Llama-3.1 feature; plain Llama-3

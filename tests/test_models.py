@@ -192,6 +192,31 @@ def test_big_config_param_counts():
     assert gemma2_9b().param_count() == pytest.approx(9.2e9, rel=0.05)
 
 
+def test_llama2_preset_and_forward():
+    """Llama-2: MHA (n_kv == n_heads), theta 1e4 — zero new mechanisms,
+    so one forward + matcher check pins the family."""
+    import dataclasses
+    from gke_ray_train_tpu.models import llama2_7b, preset_for_model_id
+    cfg = preset_for_model_id("meta-llama/Llama-2-7b-chat-hf")
+    assert cfg.name == "llama2-7b"
+    assert cfg.n_kv_heads == cfg.n_heads == 32
+    assert 6.5e9 < llama2_7b().param_count() < 7.0e9
+    # sizes dispatch like the llama-3 branch (13b/70b are real dims,
+    # not silently-7B): 70B is the family's one GQA member
+    assert preset_for_model_id("meta-llama/Llama-2-13b-hf").d_model == 5120
+    cfg70 = preset_for_model_id("meta-llama/Llama-2-70b-chat-hf")
+    assert cfg70.n_kv_heads == 8 and cfg70.n_layers == 80
+    small = dataclasses.replace(
+        llama2_7b(), vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=4, d_ff=128, max_seq_len=64, dtype="float32",
+        param_dtype="float32", remat=False)
+    params = init_params(small, jax.random.key(0))
+    logits = forward(params, jax.random.randint(
+        jax.random.key(1), (2, 16), 0, 128), small)
+    assert logits.shape == (2, 16, 128)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
 def test_flash_fallback_warns_once(caplog):
     """ADVICE r1: the flash->dense fallback for non-128-multiple seq
     lengths must warn (once per length), not silently lose the kernel."""
