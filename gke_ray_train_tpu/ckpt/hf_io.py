@@ -395,24 +395,70 @@ def save_hf_checkpoint(params: Params, cfg: ModelConfig, out_dir: str,
     write_hf_config(cfg, out_dir, dtype)
 
 
+# cfg.name prefix → (HF architectures entry, model_type). Known
+# families export a REAL HF config so `AutoConfig`/`AutoModelForCausalLM
+# .from_pretrained(out_dir)` work with stock transformers — the same
+# directly-loadable artifact the reference's save_pretrained produces
+# (/root/reference/ray-jobs/fine_tune_llama_ray.py:354-355). Unknown
+# (from-scratch) families keep the custom tag.
+_HF_ARCH = (
+    ("llama", ("LlamaForCausalLM", "llama")),
+    ("mixtral", ("MixtralForCausalLM", "mixtral")),
+    ("mistral", ("MistralForCausalLM", "mistral")),
+    ("gemma2", ("Gemma2ForCausalLM", "gemma2")),
+    ("qwen2", ("Qwen2ForCausalLM", "qwen2")),
+)
+
+
 def write_hf_config(cfg: ModelConfig, out_dir: str,
                     dtype: str = "bfloat16") -> None:
+    arch, model_type = next(
+        (v for pfx, v in _HF_ARCH if cfg.name.startswith(pfx)),
+        ("GkeRayTrainTpuForCausalLM", None))
+    out = {
+        "architectures": [arch],
+        "model_family": cfg.name,
+        "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.d_model,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.d_ff,
+        "head_dim": cfg.resolved_head_dim,
+        "rope_theta": cfg.rope_theta,
+        "rms_norm_eps": cfg.norm_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "torch_dtype": dtype,
+        "max_position_embeddings": cfg.max_seq_len,
+        "hidden_act": ("gelu_pytorch_tanh"
+                       if cfg.activation == "gelu_tanh" else "silu"),
+        **({"num_local_experts": cfg.n_experts,
+            "num_experts_per_tok": cfg.expert_top_k}
+           if cfg.n_experts else {}),
+    }
+    if model_type is not None:
+        out["model_type"] = model_type
+    if cfg.sliding_window is not None:
+        out["sliding_window"] = cfg.sliding_window
+    if cfg.attn_qkv_bias:
+        out["attention_bias"] = True
+    if cfg.rope_scaling:
+        rs = dict(cfg.rope_scaling)
+        out["rope_scaling"] = {"rope_type": "llama3", **rs}
+        # HF's llama3 rope validation requires original_max_position_
+        # embeddings < max_position_embeddings; the scaled context is
+        # original * factor (the point of the NTK rescale)
+        orig = int(rs.get("original_max_position_embeddings",
+                          cfg.max_seq_len))
+        factor = float(rs.get("factor", 1.0))
+        out["max_position_embeddings"] = max(cfg.max_seq_len,
+                                             int(orig * factor))
+    if model_type == "gemma2":
+        if cfg.attn_softcap is not None:
+            out["attn_logit_softcapping"] = cfg.attn_softcap
+        if cfg.logit_softcap is not None:
+            out["final_logit_softcapping"] = cfg.logit_softcap
+        if cfg.attn_scale is not None:
+            out["query_pre_attn_scalar"] = round(cfg.attn_scale ** -2)
     with open(os.path.join(out_dir, "config.json"), "w") as f:
-        json.dump({
-            "architectures": ["GkeRayTrainTpuForCausalLM"],
-            "model_family": cfg.name,
-            "vocab_size": cfg.vocab_size,
-            "hidden_size": cfg.d_model,
-            "num_hidden_layers": cfg.n_layers,
-            "num_attention_heads": cfg.n_heads,
-            "num_key_value_heads": cfg.n_kv_heads,
-            "intermediate_size": cfg.d_ff,
-            "head_dim": cfg.resolved_head_dim,
-            "rope_theta": cfg.rope_theta,
-            "rms_norm_eps": cfg.norm_eps,
-            "tie_word_embeddings": cfg.tie_embeddings,
-            "torch_dtype": dtype,
-            **({"num_local_experts": cfg.n_experts,
-                "num_experts_per_tok": cfg.expert_top_k}
-               if cfg.n_experts else {}),
-        }, f, indent=2)
+        json.dump(out, f, indent=2)

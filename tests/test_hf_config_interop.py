@@ -1,0 +1,59 @@
+"""Exported config.json is a REAL HF config (VERDICT-parity with the
+reference's save_pretrained output): stock transformers AutoConfig must
+load each known family's export dir and identify the right
+architecture — the artifact is directly consumable downstream, not just
+by this framework's own loader."""
+
+import dataclasses
+
+import pytest
+
+from gke_ray_train_tpu.ckpt.hf_io import write_hf_config
+from gke_ray_train_tpu.models import (
+    gemma2_9b, llama2_7b, llama3_8b, mistral_7b, mixtral_8x7b, qwen2_7b,
+    tiny)
+
+
+CASES = [
+    (llama3_8b, "LlamaConfig", "llama"),
+    (llama2_7b, "LlamaConfig", "llama"),
+    (mistral_7b, "MistralConfig", "mistral"),
+    (mixtral_8x7b, "MixtralConfig", "mixtral"),
+    (gemma2_9b, "Gemma2Config", "gemma2"),
+    (qwen2_7b, "Qwen2Config", "qwen2"),
+]
+
+
+@pytest.mark.parametrize("preset,config_cls,model_type",
+                         [(p, c, m) for p, c, m in CASES],
+                         ids=[m for _, _, m in CASES])
+def test_autoconfig_loads_export(tmp_path, preset, config_cls, model_type):
+    transformers = pytest.importorskip("transformers")
+    cfg = preset()
+    write_hf_config(cfg, str(tmp_path))
+    hf = transformers.AutoConfig.from_pretrained(str(tmp_path))
+    assert type(hf).__name__ == config_cls
+    assert hf.model_type == model_type
+    assert hf.hidden_size == cfg.d_model
+    assert hf.num_hidden_layers == cfg.n_layers
+    assert hf.num_key_value_heads == cfg.n_kv_heads
+    if model_type == "qwen2":
+        assert cfg.attn_qkv_bias  # bias is implicit in the qwen2 arch
+    if model_type == "gemma2":
+        assert hf.attn_logit_softcapping == 50.0
+        assert hf.query_pre_attn_scalar == 256
+    if model_type == "llama" and cfg.rope_scaling:
+        assert hf.rope_scaling["rope_type"] == "llama3"
+        # HF validation: original < max_position_embeddings
+        assert hf.rope_scaling["original_max_position_embeddings"] \
+            < hf.max_position_embeddings
+
+
+def test_unknown_family_keeps_custom_tag(tmp_path):
+    import json
+    cfg = dataclasses.replace(tiny(), name="basic-lm")
+    write_hf_config(cfg, str(tmp_path))
+    with open(tmp_path / "config.json") as f:
+        data = json.load(f)
+    assert data["architectures"] == ["GkeRayTrainTpuForCausalLM"]
+    assert "model_type" not in data
