@@ -14,9 +14,9 @@ import jax
 import numpy as np
 import pytest
 
-from gke_ray_train_tpu.ckpt import save_hf_checkpoint
+from gke_ray_train_tpu.ckpt import load_hf_checkpoint, save_hf_checkpoint
 from gke_ray_train_tpu.models import (
-    forward, init_params, llama3_8b, mistral_7b, qwen2_7b)
+    forward, gemma2_9b, init_params, llama3_8b, mistral_7b, qwen2_7b)
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
@@ -39,6 +39,12 @@ CASES = {
     "qwen2": lambda: tiny_dims(qwen2_7b),
     # mistral adds the sliding-window mask
     "mistral": lambda: tiny_dims(mistral_7b, sliding_window=16),
+    # gemma2: the full mechanism stack at once — sliding/global
+    # alternation, attn+final softcaps, post-block norms, (1+w) norm,
+    # gelu_tanh, tied + scaled embeddings, query_pre_attn_scalar
+    "gemma2": lambda: tiny_dims(
+        gemma2_9b, n_layers=4, head_dim=16, sliding_window=16,
+        attn_scale=16 ** -0.5),
 }
 
 
@@ -64,4 +70,38 @@ def test_forward_matches_stock_transformers(tmp_path, family):
     with torch.no_grad():
         theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
 
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_torch_saved_checkpoint_loads_exactly(tmp_path):
+    """Reverse direction: a checkpoint written by STOCK transformers
+    (save_pretrained — the hub-snapshot layout) loads through
+    load_hf_checkpoint with bit-identical weights and matching logits.
+    (Debugging note: any position-dependent logit divergence here means
+    a ROPE config mismatch, not a weight-mapping bug — position 0 is
+    rotation-free.)"""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=257, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    cfg = tiny_dims(llama3_8b, rope_theta=10000.0)
+    params = load_hf_checkpoint(str(tmp_path), cfg)
+    # weight-level exactness through the reverse mapping
+    sd = model.state_dict()
+    np.testing.assert_array_equal(
+        np.asarray(params["embed"]), sd["model.embed_tokens.weight"])
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"][0]["wq"][0]),
+        sd["model.layers.0.self_attn.q_proj.weight"].numpy().T)
+
+    tokens = np.random.default_rng(3).integers(
+        0, 257, (2, 24)).astype(np.int32)
+    ours = np.asarray(forward(params, tokens, cfg))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
