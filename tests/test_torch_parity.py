@@ -73,6 +73,23 @@ def test_forward_matches_stock_transformers(tmp_path, family):
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
 
+def _save_tiny_torch_llama(tmp_path, dtype=None):
+    """One tiny HF Llama, torch-initialized and save_pretrained'd —
+    shared by the reverse-direction and bf16 load tests."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=257, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=128, max_position_embeddings=64,
+        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    if dtype is not None:
+        model = model.to(dtype)
+    model.eval()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model
+
+
 def test_torch_saved_checkpoint_loads_exactly(tmp_path):
     """Reverse direction: a checkpoint written by STOCK transformers
     (save_pretrained — the hub-snapshot layout) loads through
@@ -80,14 +97,7 @@ def test_torch_saved_checkpoint_loads_exactly(tmp_path):
     (Debugging note: any position-dependent logit divergence here means
     a ROPE config mismatch, not a weight-mapping bug — position 0 is
     rotation-free.)"""
-    hf_cfg = transformers.LlamaConfig(
-        vocab_size=257, hidden_size=64, num_hidden_layers=2,
-        num_attention_heads=4, num_key_value_heads=2,
-        intermediate_size=128, max_position_embeddings=64,
-        rope_theta=10000.0, rms_norm_eps=1e-5, tie_word_embeddings=False)
-    torch.manual_seed(0)
-    model = transformers.LlamaForCausalLM(hf_cfg).eval()
-    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    model = _save_tiny_torch_llama(tmp_path)
 
     cfg = tiny_dims(llama3_8b, rope_theta=10000.0)
     params = load_hf_checkpoint(str(tmp_path), cfg)
@@ -105,3 +115,26 @@ def test_torch_saved_checkpoint_loads_exactly(tmp_path):
     with torch.no_grad():
         theirs = model(torch.from_numpy(tokens).long()).logits.numpy()
     np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_bf16_hub_style_checkpoint_loads(tmp_path):
+    """Hub snapshots ship bf16 safetensors; the loader must read them
+    (numpy has no native bfloat16 — ml_dtypes provides it) straight
+    into bf16 params with EXACT values and a finite forward."""
+    model = _save_tiny_torch_llama(tmp_path, dtype=torch.bfloat16)
+    cfg = tiny_dims(llama3_8b, rope_theta=10000.0, dtype="bfloat16",
+                    param_dtype="bfloat16")
+    params = load_hf_checkpoint(str(tmp_path), cfg)
+    assert str(params["embed"].dtype) == "bfloat16"
+    # value-level exactness: a wrong byte decode would be finite but
+    # garbage — compare against the torch tensors bit-for-bit (via fp32)
+    np.testing.assert_array_equal(
+        np.asarray(params["embed"], dtype=np.float32),
+        model.state_dict()["model.embed_tokens.weight"].float().numpy())
+    np.testing.assert_array_equal(
+        np.asarray(params["blocks"][0]["wq"][0], dtype=np.float32),
+        model.state_dict()[
+            "model.layers.0.self_attn.q_proj.weight"].float().numpy().T)
+    tokens = np.random.default_rng(3).integers(
+        0, 257, (2, 16)).astype(np.int32)
+    assert np.isfinite(np.asarray(forward(params, tokens, cfg))).all()
