@@ -13,7 +13,11 @@ from tests._multihost import run_entry_multiprocess
 
 
 @pytest.mark.slow
-def test_pipeline_fine_tune_two_processes(tmp_path):
+@pytest.mark.parametrize("virtual", [1, 2])
+def test_pipeline_fine_tune_two_processes(tmp_path, virtual):
+    """virtual=2 runs the circular/interleaved schedule: the entry sizes
+    the smoke model's depth to pipe x virtual (4 layers), and the ring
+    now hops 2x per microbatch across the process boundary."""
     out_base = str(tmp_path / "run")
     config = {
         "SMOKE_TEST": True,
@@ -38,4 +42,10 @@ def test_pipeline_fine_tune_two_processes(tmp_path):
         "OUTPUT_DIR_BASE": out_base,
         "INFERENCE": False,
     }
+    if virtual == 2:
+        # depth 4 (2 stages x 2 groups): default M = depth needs each
+        # microbatch divisible by the (data x fsdp) extent of 4
+        config.update(PIPE_VIRTUAL_STAGES=2,
+                      PER_DEVICE_TRAIN_BATCH_SIZE=4,
+                      PIPE_MICROBATCHES=4)
     run_entry_multiprocess("fine_tune_llama_ray.py", config)
