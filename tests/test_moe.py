@@ -186,6 +186,24 @@ def test_moe_forward_sharded_matches_unsharded():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_moe_with_ring_attention_matches_unsharded(tp_mesh):
+    """MoE x ring attention in ONE forward: the routed expert MLP and
+    the ppermute K/V ring share the context-sharded activations — the
+    one composition cell the per-sublayer tests don't reach together."""
+    cfg = moe_cfg(attn_impl="ring")
+    params = init_params(cfg, jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(7).integers(0, cfg.vocab_size, (4, 32)),
+        jnp.int32)
+    ref = forward(params, tokens,
+                  dataclasses.replace(cfg, attn_impl="xla"))
+    sharded = shard_tree(params, tp_mesh, param_specs(cfg))
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=tp_mesh))(
+        sharded, tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_moe_forward_context_sharded_matches_unsharded():
     """MoE x CP: the dispatch cumsum runs over a context-SHARDED
     sequence axis (GSPMD associative-scan collectives) — logits must
