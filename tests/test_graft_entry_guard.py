@@ -77,6 +77,17 @@ def test_probe_short_circuits_in_fallback_child(entry_mod, monkeypatch):
     assert status == "ok" and n == 8  # conftest's forced 8-device CPU
 
 
+def test_forced_probe_error_hook(entry_mod, monkeypatch):
+    """GRAFT_FORCE_PROBE=error simulates a prompt backend init failure
+    without any subprocess — the other half of the outage test hook."""
+    monkeypatch.setenv("GRAFT_FORCE_PROBE", "error")
+    monkeypatch.setattr(
+        entry_mod.subprocess, "run",
+        lambda *a, **kw: pytest.fail("forced probe must not subprocess"))
+    status, detail = entry_mod._probe_backend()
+    assert status == "error" and "GRAFT_FORCE_PROBE" in detail
+
+
 def test_entry_falls_back_to_cpu_with_marked_banner(entry_mod, monkeypatch,
                                                     capsys):
     monkeypatch.setattr(entry_mod, "_PROBE_RESULT", ("error", "boom"))

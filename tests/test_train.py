@@ -180,6 +180,19 @@ def test_flops_and_meter():
     assert 0 <= snap["mfu"]
 
 
+def test_warn_once_dedupes_by_key(caplog, monkeypatch):
+    import logging
+    from gke_ray_train_tpu import logging_utils
+    monkeypatch.setattr(logging_utils, "_seen", set())
+    lg = logging.getLogger("warn-once-test")
+    with caplog.at_level(logging.WARNING, logger="warn-once-test"):
+        logging_utils.warn_once(lg, ("k", 1), "msg %d", 1)
+        logging_utils.warn_once(lg, ("k", 1), "msg %d", 1)   # deduped
+        logging_utils.warn_once(lg, ("k", 2), "msg %d", 2)   # new key
+    msgs = [r.getMessage() for r in caplog.records]
+    assert msgs == ["msg 1", "msg 2"]
+
+
 def test_weight_decay_mask_excludes_norms_and_biases():
     """The stacked block layout makes norm scales [R, D] and q/k/v
     biases [R, dim] two-dimensional; the old ndim>=2 mask silently
