@@ -16,7 +16,8 @@ import pytest
 
 from gke_ray_train_tpu.ckpt import load_hf_checkpoint, save_hf_checkpoint
 from gke_ray_train_tpu.models import (
-    forward, gemma2_9b, init_params, llama3_8b, mistral_7b, qwen2_7b)
+    forward, gemma2_9b, init_params, llama3_8b, mistral_7b,
+    mixtral_8x7b, qwen2_7b)
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
@@ -45,6 +46,10 @@ CASES = {
     "gemma2": lambda: tiny_dims(
         gemma2_9b, n_layers=4, head_dim=16, sliding_window=16,
         attn_scale=16 ** -0.5),
+    # mixtral: our GShard static-capacity einsum dispatch vs HF's
+    # dropless per-token routing — identical when nothing drops
+    # (capacity_factor >= E/top_k = 4 is provably drop-free)
+    "mixtral": lambda: tiny_dims(mixtral_8x7b, capacity_factor=4.0),
 }
 
 
