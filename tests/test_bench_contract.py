@@ -1,0 +1,51 @@
+"""The driver contract for bench.py: exactly ONE JSON line on stdout
+with metric/value/unit/vs_baseline, exit code 0 — on any backend
+(the CPU fallback keeps the mode testable in CI). Also pins the mode
+registry against the docs/remat-default tables drifting."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_mode_registry_consistent():
+    src = open(os.path.join(REPO, "bench.py")).read()
+    # the dispatch dict and the remat-defaults table must agree
+    modes = set(re.findall(r'"([a-z0-9-]+)":\s*bench_\w+', src))
+    table = re.search(r"_REMAT_DEFAULTS = \{(.*?)\}", src, re.S).group(1)
+    remat_defaults = set(re.findall(r'"([a-z0-9-]+)":', table))
+    assert remat_defaults <= modes, (
+        f"_REMAT_DEFAULTS keys {remat_defaults - modes} not in the "
+        f"mode registry {modes}")
+    # every mode the quickstart advertises exists
+    readme = open(os.path.join(REPO, "README.md")).read()
+    for m in re.findall(r"BENCH_MODE=([a-z0-9-]+) python bench\.py",
+                        readme):
+        assert m in modes, f"README advertises unknown mode {m!r}"
+
+
+@pytest.mark.slow
+def test_bench_emits_one_json_line():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}  # deterministic default mode
+    env["JAX_PLATFORMS"] = "cpu"
+    # drop any site hook (e.g. the axon plugin's sitecustomize) that
+    # force-selects an accelerator platform via config update — the
+    # same CPU recipe the dev-box verify flow uses
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE stdout line, got: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["value"] > 0
