@@ -21,7 +21,9 @@ Mistral-7B dims, sliding-window attention, NF4 base + LoRA),
 sliding/global, softcaps, tied embeddings — packed seq 4096),
 ``seq4k`` (packed 4k llama-proxy), ``moe`` (Mixtral-pattern 8-expert
 top-2 MoE proxy), ``qwen2-lora`` (full Qwen-2.5-7B dims incl. q/k/v
-bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec).
+bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec),
+``input-bound`` (async input pipeline A/B: real packing path behind a
+deliberately slow host stall, prefetch on vs off on one JSON line).
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -448,6 +450,126 @@ def bench_moe():
         compare_baseline=False)
 
 
+def bench_input_bound():
+    """BENCH_MODE=input-bound: A/B the asynchronous input pipeline
+    (data/prefetch.py) in the regime it targets — the host is the
+    bottleneck. The REAL packing path (synthetic SQL rows → chat-format
+    tokenize → pack_examples → batch_packed) produces every batch behind
+    a deliberately slow host stall (a GIL-releasing per-batch sleep sized
+    from the measured step time, standing in for the GCS-FUSE read), and
+    feeds the real jitted train step once synchronously and once through
+    the depth-2 background prefetcher (production parallelized across
+    workers, delivery in order). One JSON line carries BOTH tokens/sec
+    numbers; value = the speedup, so the overlap win is measured, not
+    asserted."""
+    import dataclasses
+
+    from gke_ray_train_tpu.data import (
+        ByteTokenizer, batch_packed, format_gretel_sql_example,
+        make_batch_source, pack_examples, synthetic_sql_rows,
+        tokenize_sft_example)
+    from gke_ray_train_tpu.models import llama3_8b
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        size = dict(d_model=1024, n_layers=8, n_heads=16, n_kv_heads=8,
+                    d_ff=2816, vocab_size=32768)
+        B, S, steps = 8, 1024, 12
+    else:
+        size = dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_ff=512, vocab_size=2048)
+        B, S, steps = 4, 256, 12
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-input-bound", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        remat_policy=BENCH_REMAT_POLICY, **size)
+
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1), devices)
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    # donate=False: both arms start from the SAME initial state, so the
+    # loss streams are comparable and the buffers survive arm 1
+    step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule,
+                           donate=False)
+    place = make_place_batch(mesh)
+
+    tok = ByteTokenizer()
+    rows = synthetic_sql_rows(64 * B, seed=0)
+    chunk = 2 * B  # rows per batch's worth of production
+
+    def chunks(n_batches):
+        """Cheap stage: which rows feed each batch (the iterator side of
+        the pipeline — a directory listing, not the read itself)."""
+        for i in range(n_batches):
+            lo = (i * chunk) % (len(rows) - chunk + 1)
+            yield rows[lo:lo + chunk]
+
+    def produce(row_chunk, delay_s):
+        """The REAL packing path for one batch, behind an emulated
+        storage stall: chat-format tokenize → greedy pack → fixed [B,S]
+        rows. This is the stage the prefetcher parallelizes (the sleep
+        releases the GIL exactly like the FUSE/network read it stands
+        in for)."""
+        time.sleep(delay_s)
+        exs = (tokenize_sft_example(
+            tok, format_gretel_sql_example(r), max_len=S + 1)
+            for r in row_chunk)
+        return next(batch_packed(pack_examples(exs, S), B,
+                                 drop_last=False, seq_len=S))
+
+    # compile once, then size the host stall from the measured step time
+    # so the A/B sits squarely in the input-bound regime on any backend
+    placed = place(produce(rows[:chunk], 0.0))
+    st, m = step(state, placed)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        st, m = step(st, placed)
+    jax.block_until_ready(m["loss"])
+    step_s = max((time.perf_counter() - t0) / 3, 1e-4)
+    delay_s = max(1.5 * step_s, 0.01)
+
+    def run_arm(depth):
+        src = make_batch_source(
+            chunks(steps), depth=depth,
+            place_fn=lambda c: place(produce(c, delay_s)))
+        arm_state, arm_m = state, None
+        t0 = time.perf_counter()
+        try:
+            for b in src:
+                arm_state, arm_m = step(arm_state, b)
+            jax.block_until_ready(arm_m["loss"])
+        finally:
+            src.close()
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return B * S * steps / dt, float(jax.device_get(arm_m["loss"]))
+
+    tps_off, loss_off = run_arm(0)
+    tps_on, loss_on = run_arm(2)
+    _emit(
+        f"input-bound speedup prefetch-on vs prefetch-off (packed SFT "
+        f"path + {delay_s * 1e3:.0f}ms/batch host stall, "
+        f"{cfg.d_model}d/{cfg.n_layers}L seq {S}, "
+        f"{devices[0].device_kind} x{n_dev})",
+        tps_on / tps_off, "x",
+        {"prefetch_on_tokens_per_sec_per_chip": round(tps_on / n_dev, 1),
+         "prefetch_off_tokens_per_sec_per_chip": round(tps_off / n_dev, 1),
+         "prefetch_depth": 2, "host_delay_s_per_batch": round(delay_s, 4),
+         "step_time_s": round(step_s, 4),
+         # determinism witness: same batches, same state → same loss
+         "loss_prefetch_on": round(loss_on, 6),
+         "loss_prefetch_off": round(loss_off, 6)},
+        compare_baseline=False)
+
+
 def bench_decode():
     """KV-cache greedy decode tokens/sec (models/kvcache.py)."""
     import dataclasses
@@ -492,34 +614,30 @@ def main():
     # the tunneled dev TPU can be plain unavailable for hours — and in
     # the worst mode jax.devices() HANGS instead of raising (observed
     # r4: the tunnel accepts the connection and never answers). Probe
-    # in a daemon thread so a dead backend yields an honest
-    # machine-readable record instead of a wedged bench process.
-    import threading
-    probe_result = {}
-
-    def _probe():
-        try:
-            probe_result["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001 - any init failure
-            probe_result["error"] = e
-
-    th = threading.Thread(target=_probe, daemon=True)
-    th.start()
-    th.join(float(os.environ.get("BENCH_BACKEND_TIMEOUT_S", "240")))
-    if "devices" not in probe_result:
-        e = probe_result.get(
-            "error", TimeoutError("jax.devices() unresponsive"))
+    # through __graft_entry__'s memoized SUBPROCESS probe (the same one
+    # the driver entry points share): nothing in THIS process touches a
+    # backend-initializing jax API until a child confirms the backend
+    # answers, so a wedged tunnel fails loudly with a machine-readable
+    # record instead of wedging the whole baseline sweep — the old
+    # in-process daemon-thread probe left jax permanently hung for any
+    # later call even when its join timed out (ADVICE r5 #1).
+    import __graft_entry__ as graft
+    timeout_s = (float(os.environ["BENCH_BACKEND_TIMEOUT_S"])
+                 if "BENCH_BACKEND_TIMEOUT_S" in os.environ else None)
+    status, detail = graft._probe_backend(timeout_s=timeout_s)
+    if status != "ok":
         print(json.dumps({
             "metric": f"bench {mode} NOT RUN - accelerator backend "
-                      "init failed",
+                      f"{status}",
             "value": 0.0, "unit": "error", "vs_baseline": 0.0,
-            "error": str(e).replace("\n", " ")[:200]}))
+            "error": str(detail).replace("\n", " ")[:200]}))
         sys.exit(1)
     {"train": bench_train, "qlora8b": bench_qlora8b,
      "mistral7b-lora": bench_mistral7b_lora,
      "gemma2-4k": bench_gemma2_4k,
      "seq4k": bench_seq4k, "moe": bench_moe,
      "qwen2-lora": bench_qwen2_lora,
+     "input-bound": bench_input_bound,
      "decode": bench_decode}[mode]()
 
 

@@ -444,15 +444,19 @@ def write_hf_config(cfg: ModelConfig, out_dir: str,
         out["attention_bias"] = True
     if cfg.rope_scaling:
         rs = dict(cfg.rope_scaling)
+        # Exported bit-identical to training: every rope_scaling field
+        # (factor, band factors, original_max_position_embeddings) FEEDS
+        # HF's _compute_llama3_parameters, so clamping any of them would
+        # silently change the loaded model's rotary frequencies.
+        # max_position_embeddings stays the context the model was
+        # actually built/trained with (cfg.max_seq_len) — the old
+        # original*factor inflation (65536 for the Llama-3.1 preset)
+        # advertised a context matching neither this model nor the stock
+        # HF checkpoint (ADVICE r5 #2). When max_seq_len <= original,
+        # HF's llama3 validation logs a warning (original must be <
+        # max_position_embeddings) but loads fine — frequencies depend
+        # only on rope_scaling, never on max_position_embeddings.
         out["rope_scaling"] = {"rope_type": "llama3", **rs}
-        # HF's llama3 rope validation requires original_max_position_
-        # embeddings < max_position_embeddings; the scaled context is
-        # original * factor (the point of the NTK rescale)
-        orig = int(rs.get("original_max_position_embeddings",
-                          cfg.max_seq_len))
-        factor = float(rs.get("factor", 1.0))
-        out["max_position_embeddings"] = max(cfg.max_seq_len,
-                                             int(orig * factor))
     if model_type == "gemma2":
         if cfg.attn_softcap is not None:
             out["attn_logit_softcapping"] = cfg.attn_softcap

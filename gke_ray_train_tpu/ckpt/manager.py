@@ -98,19 +98,34 @@ class CheckpointManager:
         ckptr = ocp.PyTreeCheckpointer()
         meta = ckptr.metadata(
             os.path.join(str(self.directory), str(step), "default"))
-        return meta.item_metadata.tree
+        # orbax >= 0.6 wraps the tree (CheckpointMetadata.item_metadata
+        # .tree); older releases hand the metadata tree back directly
+        if hasattr(meta, "item_metadata"):
+            return meta.item_metadata.tree
+        return meta
 
     def restore_partial(self, abstract: Any,
                         step: Optional[int] = None) -> Any:
-        """Restore only the leaves of ``abstract`` that are NOT
-        ``orbax.checkpoint.PLACEHOLDER`` — the offline converter reads
-        one leaf at a time this way, so a 70B conversion needs O(one
-        leaf) RAM instead of the whole tree (VERDICT r3 weak #4b)."""
+        """Restore only a subset of the saved tree — the offline
+        converter reads one leaf at a time this way, so a 70B conversion
+        needs O(one leaf) RAM instead of the whole tree (VERDICT r3 weak
+        #4b).
+
+        On orbax with ``PLACEHOLDER`` support, ``abstract`` is the full
+        structure with every unwanted leaf placeholder'd; on older
+        releases it is the partial subtree and ``transforms={}`` tells
+        the handler to drop checkpoint entries not present in it."""
         step = step if step is not None else self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
-        return self._mgr.restore(step, args=ocp.args.PyTreeRestore(
-            item=abstract))
+        if hasattr(ocp, "PLACEHOLDER"):
+            args = ocp.args.PyTreeRestore(item=abstract)
+        else:
+            args = ocp.args.PyTreeRestore(
+                item=abstract, transforms={},
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    abstract))
+        return self._mgr.restore(step, args=args)
 
     def restore_if_available(self, state_like: Any):
         """(state, resumed_step) — the resume-on-retry behavior the

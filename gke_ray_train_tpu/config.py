@@ -45,6 +45,9 @@ KNOWN_KEYS = frozenset({
     "EVALUATION_STRATEGY_SFT", "EVAL_STEPS_SFT", "REPORT_TO",
     # sequence handling
     "MAX_SEQ_LENGTH", "PACKING", "GROUP_BY_LENGTH",
+    # input pipeline (data/prefetch.py): queue depth of the background
+    # prefetch+placement thread; 0 = synchronous
+    "PREFETCH_BATCHES",
     # inference comparison
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",

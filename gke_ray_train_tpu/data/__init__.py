@@ -10,3 +10,5 @@ from gke_ray_train_tpu.data.sft import (  # noqa: F401
 from gke_ray_train_tpu.data.packing import (  # noqa: F401
     pack_examples, batch_packed)
 from gke_ray_train_tpu.data.prepare import prepare_wikitext2  # noqa: F401
+from gke_ray_train_tpu.data.prefetch import (  # noqa: F401
+    Prefetcher, SyncBatchSource, make_batch_source)

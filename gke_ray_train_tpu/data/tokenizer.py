@@ -138,8 +138,20 @@ def load_saved_tokenizer(model_dir: str):
     if os.path.exists(sidecar):
         with open(sidecar) as f:
             data = json.load(f)
+        kind = data.get("type")
         # legacy char files predate the "type" field but carry "stoi"
-        if data.get("type") == "char" or "stoi" in data:
+        if kind == "char" or (kind is None and "stoi" in data):
+            if "stoi" not in data:
+                raise ValueError(
+                    f"char tokenizer sidecar {sidecar} has no 'stoi' "
+                    "vocabulary — the file is corrupted")
             return CharTokenizer(data["stoi"])
-        return ByteTokenizer()
+        if kind == "byte":
+            return ByteTokenizer()
+        # an unknown type must FAIL, not silently decode with the wrong
+        # vocabulary (ADVICE r5 #3: a future 'bpe' sidecar or corrupted
+        # JSON used to fall through to ByteTokenizer)
+        raise ValueError(
+            f"unrecognized tokenizer sidecar type {kind!r} in {sidecar} "
+            "(known: 'char', 'byte') — refusing to guess a vocabulary")
     return load_hf_tokenizer(model_dir)

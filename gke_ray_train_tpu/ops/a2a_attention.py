@@ -24,7 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from gke_ray_train_tpu.ops.smap import shard_map
 from jax.sharding import PartitionSpec as P
 
 from gke_ray_train_tpu.ops import flash_attention as fa

@@ -31,6 +31,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# pallas < 0.5 spells it TPUCompilerParams; alias locally, never mutate
+# the third-party module
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+if _CompilerParams is None:
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams; unsupported pallas version")
+
 from gke_ray_train_tpu.ops.attention import NEG_INF
 
 # tuned on v5e (8x2048x16h/8kv/128dh bf16 fwd+bwd sweep: 13.1 ms vs
@@ -226,7 +235,7 @@ def _fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, *, scale, causal, window,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -381,7 +390,7 @@ def _bwd(res, g, *, scale, causal, window, softcap, block_q, block_kv,
                                lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, S, dh), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -427,7 +436,7 @@ def _bwd(res, g, *, scale, causal, window, softcap, block_q, block_kv,
             pltpu.VMEM((block_kv, dh), jnp.float32),
             pltpu.VMEM((block_kv, dh), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,

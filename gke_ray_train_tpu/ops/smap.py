@@ -1,0 +1,19 @@
+"""shard_map across jax versions.
+
+jax >= 0.6 exports ``jax.shard_map`` with a ``check_vma`` kwarg; on
+0.4.x the function lives at ``jax.experimental.shard_map.shard_map``
+and the same knob is spelled ``check_rep``. Every ops module imports
+from here so the kernels are written against the current API and still
+run on the older runtime the container ships.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map  # noqa: F401
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma)

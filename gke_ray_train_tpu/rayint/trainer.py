@@ -106,7 +106,17 @@ class JaxTrainer:
                  run_config: Optional[RunConfig] = None,
                  use_ray: Optional[bool] = None):
         self.fn = train_loop_per_worker
-        self.config = train_loop_config or {}
+        # copied: env-derived injections below must not leak into the
+        # caller's dict (it may be reused or serialized as a job spec)
+        self.config = dict(train_loop_config or {})
+        # input-pipeline knob threaded through config so `ray job submit
+        # --env PREFETCH_BATCHES=N` tunes the async prefetch depth
+        # (data/prefetch.py) without editing the job JSON; an explicit
+        # config value always wins over the driver env
+        if "PREFETCH_BATCHES" in os.environ and \
+                "PREFETCH_BATCHES" not in self.config:
+            self.config["PREFETCH_BATCHES"] = \
+                int(os.environ["PREFETCH_BATCHES"])
         self.scaling = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.use_ray = (_HAS_RAY and self.scaling.num_workers >= 1

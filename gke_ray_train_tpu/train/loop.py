@@ -23,6 +23,7 @@ from typing import Callable, Iterable, Optional
 import jax
 import numpy as np
 
+from gke_ray_train_tpu.data.prefetch import make_batch_source
 from gke_ray_train_tpu.train.metrics import ThroughputMeter, paused
 from gke_ray_train_tpu.train.step import TrainState
 
@@ -44,6 +45,7 @@ def run_training(state: TrainState,
                  eval_at_epoch_end: bool = False,
                  ckpt_every: Optional[int] = None,
                  place_batch: Optional[Callable] = None,
+                 prefetch: int = 0,
                  ckpt_view: Optional[tuple] = None,
                  profiler=None,
                  tb_writer=None,
@@ -52,6 +54,14 @@ def run_training(state: TrainState,
 
     epoch_batches(epoch) → iterable of host-local numpy batch dicts.
     place_batch(batch) → device arrays (sharded form-up); default asis.
+    prefetch: queue depth of the asynchronous input pipeline
+    (data/prefetch.py) — a background thread runs the epoch iterator AND
+    ``place_batch`` ahead of the step, overlapping tokenize/pack and the
+    host→device transfer with device compute. 0 = synchronous (identical
+    batch stream either way; resume fast-forward never transfers skipped
+    batches on either path). When a meter is attached, the fraction of
+    the train window spent blocked on the pipeline is surfaced as
+    ``data_stall_frac`` in the periodic log line and TB scalars.
     report_fn(metrics_dict) → trainer-context report (Ray or local).
     ckpt_view: optional (save_view, load_view) pair mapping the state to
     the subset the checkpoint persists — LoRA mode saves only adapters +
@@ -96,21 +106,27 @@ def run_training(state: TrainState,
         if meter is not None:
             meter.reset()
         m = None
-        yielded = 0
         trained_this_epoch = 0
-        for batch in epoch_batches(epoch):
-            yielded += 1
-            if to_skip > 0:
-                to_skip -= 1
-                continue
+        # one iteration shape for both pipelines: the source pulls from
+        # the epoch iterator, applies the resume fast-forward skip
+        # (skipped batches are consumed but NEVER placed/transferred),
+        # and runs place_batch — inline when prefetch=0, on a background
+        # thread with a depth-`prefetch` device-resident queue otherwise
+        source = make_batch_source(epoch_batches(epoch),
+                                   place_fn=place_batch,
+                                   depth=prefetch, skip=to_skip)
+        try:
+          for batch in source:
+            wait_s = source.consume_wait()
             if trained_this_epoch == 0 and meter is not None:
                 # fast-forwarding consumed batches costs wall clock
                 # (tokenize/pack) that must not deflate the tokens/sec
-                # window of the steps actually trained
+                # window of the steps actually trained — the reset also
+                # drops the first batch's pipeline-warmup wait
                 meter.reset()
+            elif meter is not None:
+                meter.data_wait(wait_s)
             trained_this_epoch += 1
-            if place_batch is not None:
-                batch = place_batch(batch)
             state, m = train_step(state, batch)
             global_step += 1
             if profiler is not None:
@@ -133,6 +149,7 @@ def run_training(state: TrainState,
                         m_host.get("learning_rate", float("nan")),
                         (f" tok/s/chip {last_metrics['tokens_per_sec_per_chip']:.0f}"
                          f" mfu {last_metrics['mfu']:.1%}"
+                         f" stall {last_metrics['data_stall_frac']:.1%}"
                          if meter is not None else ""))
             if eval_fn is not None and eval_every and \
                     global_step % eval_every == 0:
@@ -158,6 +175,13 @@ def run_training(state: TrainState,
                 with paused(meter):
                     ckpt_manager.save(global_step, save_view(state),
                                       metrics=m_host)
+        finally:
+            # normal exhaustion already joined the workers; this reclaims
+            # them on the exception path (a failing step must not leak
+            # prefetch threads parked on backpressure)
+            source.close()
+        yielded = source.yielded
+        to_skip -= source.skipped
 
         # end of epoch: checkpoint + report (collective; all hosts enter)
         if m is None:

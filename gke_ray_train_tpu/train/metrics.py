@@ -105,6 +105,7 @@ class ThroughputMeter:
     _steps: int = 0
     _paused_total: float = 0.0
     _pause_t0: Optional[float] = None
+    _data_wait: float = 0.0
 
     def __post_init__(self):
         if self.peak_flops is None:
@@ -113,6 +114,14 @@ class ThroughputMeter:
     def update(self, tokens_this_step: float) -> None:
         self._tokens += float(tokens_this_step)
         self._steps += 1
+
+    def data_wait(self, seconds: float) -> None:
+        """Book host seconds the loop spent blocked on the input pipeline
+        (queue wait under prefetch; iterate+place time synchronously).
+        Feeds ``data_stall_frac`` — the fraction of the training window
+        the accelerator idled for data, i.e. what prefetch should drive
+        to ~0 once the host is no longer the bottleneck."""
+        self._data_wait += max(float(seconds), 0.0)
 
     def pause(self) -> None:
         """Mark the start of a non-training stall (eval, ckpt save)."""
@@ -130,6 +139,7 @@ class ThroughputMeter:
         self._steps = 0
         self._paused_total = 0.0
         self._pause_t0 = None
+        self._data_wait = 0.0
 
     def snapshot(self) -> dict:
         now = time.perf_counter()
@@ -151,6 +161,9 @@ class ThroughputMeter:
             "tokens_per_sec_per_chip": tps / max(self.n_devices, 1),
             "mfu": mfu,
             "steps_per_sec": self._steps / dt,
+            # input-pipeline health: fraction of the training window the
+            # loop sat blocked waiting for the next (placed) batch
+            "data_stall_frac": min(self._data_wait / dt, 1.0),
             # cumulative (stall-inclusive) job view
             "tokens_per_sec_per_chip_incl_stalls":
                 tps_wall / max(self.n_devices, 1),

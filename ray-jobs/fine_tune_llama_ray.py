@@ -325,6 +325,10 @@ def train_loop_per_worker(config: dict):
         state, step_fn, epoch_batches,
         epochs=epochs,
         place_batch=place,
+        # asynchronous input pipeline (data/prefetch.py): tokenize/pack +
+        # sharded host→device transfer overlap the train step; depth 2
+        # device-resident batches by default, 0 = synchronous
+        prefetch=int(config.get("PREFETCH_BATCHES", 2)),
         log_every=int(config.get("LOGGING_STEPS", 10)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),

@@ -134,6 +134,10 @@ def train_loop_per_worker(config: dict):
         # host-local rows → global sharded arrays (SURVEY.md row D9)
         place_batch=make_place_batch(
             mesh, context_sharded=mesh.shape["context"] > 1),
+        # background prefetch overlaps the sliding-window slice + form-up
+        # with the step (data/prefetch.py); 0 = synchronous
+        prefetch=int(config.get("prefetch_batches",
+                                config.get("PREFETCH_BATCHES", 2))),
         log_every=int(config.get("log_every", 20)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),
@@ -166,6 +170,7 @@ if __name__ == "__main__":
         **({"max_samples": int(os.environ.get("MAX_SAMPLES", "1600"))}
            if smoke else {}),
         "log_every": 20,
+        "prefetch_batches": int(os.environ.get("PREFETCH_BATCHES", "2")),
         "dtype": "float32" if smoke else "bfloat16",
         "data_dir": os.environ.get("DATA_DIR", "/mnt/pvc/data"),
         "storage_path": os.environ.get(

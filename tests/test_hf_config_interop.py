@@ -44,9 +44,15 @@ def test_autoconfig_loads_export(tmp_path, preset, config_cls, model_type):
         assert hf.query_pre_attn_scalar == 256
     if model_type == "llama" and cfg.rope_scaling:
         assert hf.rope_scaling["rope_type"] == "llama3"
-        # HF validation: original < max_position_embeddings
+        # functional RoPE params round-trip BIT-IDENTICAL to training —
+        # HF computes rotary frequencies from these, so any clamp/inflate
+        # would silently change the exported model's logits — and the
+        # advertised context is the one the model was built with
+        rs = dict(cfg.rope_scaling)
         assert hf.rope_scaling["original_max_position_embeddings"] \
-            < hf.max_position_embeddings
+            == rs["original_max_position_embeddings"]
+        assert hf.rope_scaling["factor"] == rs["factor"]
+        assert hf.max_position_embeddings == cfg.max_seq_len
 
 
 def test_unknown_family_keeps_custom_tag(tmp_path):

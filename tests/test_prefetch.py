@@ -1,0 +1,296 @@
+"""Asynchronous input pipeline (data/prefetch.py).
+
+Pins the contract the train loop depends on: deterministic (ticket-
+ordered) delivery, bounded device-resident depth, exception propagation
+to the consumer, clean thread shutdown, resume fast-forward that never
+transfers skipped batches, and — at loop level — bitwise-identical
+losses with prefetch on vs. off plus the data-stall metric surfacing.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.data.prefetch import (
+    Prefetcher, SyncBatchSource, make_batch_source)
+
+
+def _batches(n):
+    for i in range(n):
+        yield {"inputs": np.full((2, 4), i, np.int32)}
+
+
+# ---------------------------------------------------------------------
+# unit: ordering / depth / errors / shutdown / skip
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("workers", [1, 2, 4])
+def test_ordering_preserved(workers):
+    placed = []
+
+    def place(b):
+        # jitter placement latency so out-of-order completion would be
+        # exposed if delivery did not reassemble by ticket
+        time.sleep(0.001 * (b["inputs"][0, 0] % 3))
+        placed.append(int(b["inputs"][0, 0]))
+        return b
+
+    src = Prefetcher(_batches(12), place_fn=place, depth=4,
+                     workers=workers)
+    out = [int(b["inputs"][0, 0]) for b in src]
+    assert out == list(range(12))
+    assert sorted(placed) == list(range(12))
+    assert src.yielded == 12 and src.skipped == 0
+
+
+def test_queue_depth_bounded():
+    produced = []
+    lock = threading.Lock()
+
+    def place(b):
+        with lock:
+            produced.append(int(b["inputs"][0, 0]))
+        return b
+
+    depth, workers = 2, 2
+    src = Prefetcher(_batches(50), place_fn=place, depth=depth,
+                     workers=workers)
+    try:
+        it = iter(src)
+        next(it)
+        time.sleep(0.5)  # slow consumer: workers must hit backpressure
+        # <= 1 consumed + `depth` queued + `workers` mid-placement
+        assert len(produced) <= 1 + depth + workers
+    finally:
+        src.close()
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+def test_iterator_exception_reraised_after_good_batches(workers):
+    def gen():
+        yield from _batches(3)
+        raise RuntimeError("tokenizer blew up")
+
+    src = Prefetcher(gen(), depth=2, workers=workers)
+    got = []
+    with pytest.raises(RuntimeError, match="tokenizer blew up"):
+        for b in src:
+            got.append(int(b["inputs"][0, 0]))
+    assert got == [0, 1, 2], "batches before the error must deliver"
+    for t in src._threads:
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+
+def test_place_exception_reraised_in_order():
+    def place(b):
+        if int(b["inputs"][0, 0]) == 2:
+            raise ValueError("device_put failed")
+        return b
+
+    src = Prefetcher(_batches(6), place_fn=place, depth=3, workers=2)
+    got = []
+    with pytest.raises(ValueError, match="device_put failed"):
+        for b in src:
+            got.append(int(b["inputs"][0, 0]))
+    assert got == [0, 1]
+
+
+def test_shutdown_leaks_no_threads():
+    before = threading.active_count()
+
+    def endless():
+        i = 0
+        while True:
+            yield {"inputs": np.full((2, 4), i, np.int32)}
+            i += 1
+
+    src = Prefetcher(endless(), depth=2, workers=2)
+    next(iter(src))
+    src.close()
+    for t in src._threads:
+        assert not t.is_alive()
+    assert threading.active_count() <= before
+    # close() is idempotent, and a closed source stops iterating
+    src.close()
+    with pytest.raises(StopIteration):
+        next(iter(src))
+
+
+def test_exhausted_source_joins_workers():
+    src = Prefetcher(_batches(3), depth=2)
+    assert [int(b["inputs"][0, 0]) for b in src] == [0, 1, 2]
+    for t in src._threads:
+        assert not t.is_alive()
+
+
+@pytest.mark.parametrize("factory", [
+    lambda it, place, skip: Prefetcher(it, place_fn=place, skip=skip,
+                                       depth=2, workers=2),
+    lambda it, place, skip: SyncBatchSource(it, place_fn=place, skip=skip),
+])
+def test_resume_skip_never_transfers(factory):
+    placed = []
+
+    def place(b):
+        placed.append(int(b["inputs"][0, 0]))
+        return b
+
+    src = factory(_batches(6), place, 4)
+    out = [int(b["inputs"][0, 0]) for b in src]
+    assert out == [4, 5]
+    assert sorted(placed) == [4, 5], \
+        "skipped batches must never reach place_fn"
+    assert src.yielded == 6 and src.skipped == 4
+
+
+def test_make_batch_source_dispatch():
+    assert isinstance(make_batch_source(_batches(1), depth=0),
+                      SyncBatchSource)
+    src = make_batch_source(_batches(1), depth=2)
+    assert isinstance(src, Prefetcher)
+    src.close()
+    with pytest.raises(ValueError):
+        Prefetcher(_batches(1), depth=0)
+
+
+def test_consume_wait_accumulates():
+    def slow():
+        for i in range(3):
+            time.sleep(0.05)
+            yield {"inputs": np.full((2, 4), i, np.int32)}
+
+    src = SyncBatchSource(slow())
+    next(iter(src))
+    assert src.consume_wait() >= 0.04
+    assert src.consume_wait() == 0.0  # drained
+
+
+# ---------------------------------------------------------------------
+# loop level: determinism + stall metric + resume
+# ---------------------------------------------------------------------
+
+def _loop_fixture():
+    import jax
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step_fn = make_train_step(cfg, opt, donate=False)
+
+    def batches(epoch):
+        for i in range(6):
+            k = jax.random.key(epoch * 10 + i)
+            yield {
+                "inputs": np.asarray(
+                    jax.random.randint(k, (2, 8), 0, 64)),
+                "targets": np.asarray(
+                    jax.random.randint(k, (2, 8), 0, 64)),
+                "weights": np.ones((2, 8), np.float32),
+            }
+
+    return cfg, state, step_fn, batches
+
+
+def _run_collecting_losses(state, step_fn, batches, prefetch, **kw):
+    import jax
+
+    from gke_ray_train_tpu.train.loop import run_training
+
+    losses = []
+
+    def recording_step(st, b):
+        st, m = step_fn(st, b)
+        losses.append(float(jax.device_get(m["loss"])))
+        return st, m
+
+    final, metrics = run_training(state, recording_step, batches,
+                                  epochs=2, prefetch=prefetch, **kw)
+    return losses, final, metrics
+
+
+def test_loop_losses_identical_prefetch_on_off():
+    import jax
+
+    cfg, state, step_fn, batches = _loop_fixture()
+    losses_off, final_off, _ = _run_collecting_losses(
+        state, step_fn, batches, prefetch=0)
+    losses_on, final_on, _ = _run_collecting_losses(
+        state, step_fn, batches, prefetch=3)
+    assert losses_off == losses_on, \
+        "prefetch must not change the training stream (bitwise)"
+    assert int(jax.device_get(final_off.step)) == \
+        int(jax.device_get(final_on.step)) == 12
+
+
+def test_loop_place_batch_runs_on_prefetch_thread():
+    cfg, state, step_fn, batches = _loop_fixture()
+    seen_threads = []
+
+    def place(b):
+        seen_threads.append(threading.current_thread().name)
+        return b
+
+    _run_collecting_losses(state, step_fn, batches, prefetch=2,
+                           place_batch=place)
+    assert seen_threads and all("batch-prefetch" in n
+                                for n in seen_threads)
+
+
+def test_loop_resume_skip_with_prefetch_never_places(tmp_path):
+    import jax
+
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg, state, step_fn, batches = _loop_fixture()
+    d = str(tmp_path / "run")
+    mgr = CheckpointManager(d, async_save=False)
+    run_training(state, step_fn, batches, epochs=1, ckpt_manager=mgr,
+                 prefetch=2)
+    mgr.close()
+
+    placed = []
+    cfg2, state2, step_fn2, _ = _loop_fixture()
+
+    def place(b):
+        placed.append(b)
+        return b
+
+    mgr2 = CheckpointManager(d, async_save=False)
+    final2, _ = run_training(state2, step_fn2, batches, epochs=2,
+                             ckpt_manager=mgr2, prefetch=2,
+                             place_batch=place)
+    mgr2.close()
+    # epoch 0 (6 batches) was fully consumed pre-resume: zero transfers
+    # for it; epoch 1 trains its 6 batches, each placed exactly once
+    assert int(jax.device_get(final2.step)) == 12
+    assert len(placed) == 6
+
+
+def test_loop_surfaces_data_stall_fraction():
+    from gke_ray_train_tpu.train import ThroughputMeter
+
+    cfg, state, step_fn, batches = _loop_fixture()
+
+    def slow_batches(epoch):
+        for b in batches(epoch):
+            time.sleep(0.02)
+            yield b
+
+    meter = ThroughputMeter(cfg, seq_len=8, n_devices=1, peak_flops=1e12)
+    losses, _, metrics = _run_collecting_losses(
+        state, step_fn, slow_batches, prefetch=0, meter=meter,
+        log_every=2)
+    assert "data_stall_frac" in metrics
+    assert 0.0 <= metrics["data_stall_frac"] <= 1.0
+    # a deliberately slow synchronous iterator must register as stall
+    assert metrics["data_stall_frac"] > 0.05
