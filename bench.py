@@ -23,7 +23,9 @@ sliding/global, softcaps, tied embeddings — packed seq 4096),
 top-2 MoE proxy), ``qwen2-lora`` (full Qwen-2.5-7B dims incl. q/k/v
 bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec),
 ``input-bound`` (async input pipeline A/B: real packing path behind a
-deliberately slow host stall, prefetch on vs off on one JSON line).
+deliberately slow host stall, prefetch on vs off on one JSON line),
+``recovery`` (fault drill: time-to-recover from an injected kill +
+checkpoint-save latency under SIGTERM, testing/faults.py).
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -570,6 +572,131 @@ def bench_input_bound():
         compare_baseline=False)
 
 
+def bench_recovery():
+    """BENCH_MODE=recovery: fault-tolerance drill on the attached
+    chip(s), deterministic via testing/faults.py. Two measured numbers
+    on one JSON line: value = time-to-recover (injected kill at step 6 →
+    first post-resume step completion, covering restore + state rebuild
+    + resume fast-forward), and the checkpoint-save latency under
+    SIGTERM (the number that must fit PREEMPT_GRACE_S)."""
+    import shutil
+    import tempfile
+
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.rayint import (
+        FailureConfig, JaxTrainer, RunConfig)
+    from gke_ray_train_tpu.testing.faults import (
+        FaultInjector, parse_fault_spec, reset_fired)
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step, preempt)
+    from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.preempt import Preempted
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        size = dict(d_model=512, n_layers=4, n_heads=8, n_kv_heads=4,
+                    d_ff=1024, vocab_size=4096)
+        B, S = 8, 256
+    else:
+        size = dict(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+                    d_ff=128, vocab_size=256)
+        B, S = 2, 32
+    steps, kill_step, ckpt_every = 12, 6, 4
+    cfg = tiny(**size, max_seq_len=S, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+
+    def batches(epoch):
+        for i in range(steps):
+            k = jax.random.key(epoch * 100 + i)
+            yield {
+                "inputs": jax.random.randint(k, (B, S), 0,
+                                             cfg.vocab_size),
+                "targets": jax.random.randint(k, (B, S), 0,
+                                              cfg.vocab_size),
+                "weights": jnp.ones((B, S), jnp.float32),
+            }
+
+    work = tempfile.mkdtemp(prefix="bench_recovery_")
+    try:
+        # ---- kill drill: time from the killed step to the first
+        # post-resume step completion, through the real retry loop -----
+        reset_fired()
+        beats = []
+
+        def worker(config):
+            state = make_train_state(cfg, opt, jax.random.key(0))
+            step_fn = make_train_step(cfg, opt, donate=False)
+            mgr = CheckpointManager(
+                os.path.join(work, "kill"), max_to_keep=2,
+                score_attribute=None, async_save=False)
+            inj = FaultInjector(
+                parse_fault_spec(f"rank=0:kind=kill:step={kill_step}"),
+                rank=0, ckpt_manager=mgr)
+            try:
+                final, _ = run_training(
+                    state, step_fn, batches, epochs=1,
+                    ckpt_manager=mgr, ckpt_every=ckpt_every,
+                    heartbeat_fn=lambda step, done=False: beats.append(
+                        (step, time.perf_counter())),
+                    fault_injector=inj)
+            finally:
+                mgr.close()
+            return {"final_step": int(jax.device_get(final.step))}
+
+        res = JaxTrainer(
+            worker, use_ray=False,
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=1),
+                retry_backoff_s=0.0)).fit()
+        if res.error or res.metrics.get("final_step") != steps:
+            raise RuntimeError(f"recovery drill did not converge: {res}")
+        # the restart shows up as the step sequence going backwards:
+        # beats run (…, kill_step) then (resume_step+1, …) — the retry's
+        # first beat is its first COMPLETED step after the resume point
+        restart = next(i for i in range(1, len(beats))
+                       if beats[i][0] < beats[i - 1][0])
+        time_to_recover = beats[restart][1] - beats[restart - 1][1]
+        resumed_step = beats[restart][0] - 1
+
+        # ---- sigterm drill: grace-window checkpoint latency ----------
+        reset_fired()
+        preempt.reset()
+        state = make_train_state(cfg, opt, jax.random.key(0))
+        step_fn = make_train_step(cfg, opt, donate=False)
+        mgr = CheckpointManager(os.path.join(work, "sigterm"),
+                                max_to_keep=2, score_attribute=None,
+                                async_save=False)
+        inj = FaultInjector(
+            parse_fault_spec(f"rank=0:kind=sigterm:step={kill_step}"),
+            rank=0, ckpt_manager=mgr)
+        try:
+            run_training(state, step_fn, batches, epochs=1,
+                         ckpt_manager=mgr, fault_injector=inj)
+            raise RuntimeError("sigterm fault did not fire")
+        except Preempted as p:
+            sigterm_save_s = p.save_s
+        finally:
+            mgr.close()
+            preempt.reset()
+            preempt.uninstall()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    _emit(
+        f"time-to-recover injected kill@step{kill_step} -> first "
+        f"post-resume step ({cfg.d_model}d/{cfg.n_layers}L seq {S}, "
+        f"{devices[0].device_kind})",
+        time_to_recover, "s",
+        {"sigterm_ckpt_save_s": round(sigterm_save_s, 4),
+         "kill_step": kill_step, "resumed_step": int(resumed_step),
+         "ckpt_every": ckpt_every, "steps": steps,
+         "attempts": res.attempts},
+        compare_baseline=False)
+
+
 def bench_decode():
     """KV-cache greedy decode tokens/sec (models/kvcache.py)."""
     import dataclasses
@@ -638,6 +765,7 @@ def main():
      "seq4k": bench_seq4k, "moe": bench_moe,
      "qwen2-lora": bench_qwen2_lora,
      "input-bound": bench_input_bound,
+     "recovery": bench_recovery,
      "decode": bench_decode}[mode]()
 
 

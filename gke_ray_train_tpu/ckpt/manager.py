@@ -25,6 +25,14 @@ import orbax.checkpoint as ocp
 logger = logging.getLogger(__name__)
 
 
+class CheckpointRestoreError(RuntimeError):
+    """A collectively-agreed restore failure. The trainer's retry
+    classifier treats this as RETRYABLE even though the underlying
+    orbax/tensorstore cause is often a ValueError (which would
+    otherwise fail fast as 'deterministic') — a fresh attempt re-reads
+    storage and can succeed where a flake failed."""
+
+
 class CheckpointManager:
     """Thin orbax wrapper carrying the reference's retention contract."""
 
@@ -127,15 +135,112 @@ class CheckpointManager:
                     abstract))
         return self._mgr.restore(step, args=args)
 
+    @staticmethod
+    def _any_host_failed(local_failed: bool) -> bool:
+        """Collective agreement on a restore outcome: every host enters,
+        every host leaves with the same verdict — the prerequisite for
+        a fallback/quarantine that cannot diverge the slice."""
+        if jax.process_count() <= 1:
+            return local_failed
+        from jax.experimental import multihost_utils
+        import numpy as np
+        flags = multihost_utils.process_allgather(
+            np.asarray(1 if local_failed else 0, np.int32))
+        return bool(np.max(flags))
+
+    def _quarantine(self, step: int) -> str:
+        """Move an unrestorable step directory aside (``<step>.corrupt``)
+        so it never shadows a good checkpoint again, and refresh the
+        manager's step cache. All hosts enter (the verdict was
+        collective); host 0 renames, everyone syncs before reloading."""
+        import os
+        import shutil
+
+        src = os.path.join(str(self.directory), str(step))
+        dst = src + ".corrupt"
+        n = 0
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{src}.corrupt{n}"
+        multi = jax.process_count() > 1
+        if not multi or jax.process_index() == 0:
+            if os.path.isdir(src):
+                shutil.move(src, dst)
+        if multi:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt_quarantine_{step}")
+        if hasattr(self._mgr, "reload"):
+            self._mgr.reload()
+        else:  # pragma: no cover - pre-reload orbax
+            self._mgr = ocp.CheckpointManager(self.directory,
+                                              options=self._options)
+        return dst
+
     def restore_if_available(self, state_like: Any):
         """(state, resumed_step) — the resume-on-retry behavior the
-        reference lacks. Returns (state_like, None) on a fresh start."""
-        step = self._mgr.latest_step()
-        if step is None:
+        reference lacks. Returns (state_like, None) on a fresh start.
+
+        Integrity fallback: the latest step is VERIFIED by restoring it;
+        when that fails (an interrupted async save left a committed but
+        torn tail — without this, every subsequent resume crashes on the
+        same bad step) the newest earlier restorable step is used and
+        each newer unrestorable step is quarantined as ``<step>.corrupt``.
+        When EVERY step fails the first error re-raises and nothing is
+        quarantined: that signature is a template/layout mismatch (the
+        caller's pytree, not the data, is wrong — see the ckpt_view
+        fallback in train/loop.py), and quarantining healthy checkpoints
+        on a caller bug would destroy the run's only resume points.
+
+        Each step gets one bounded retry before being declared
+        unrestorable — a transient storage flake must not cost the
+        newest checkpoint. On multi-host runs every verdict is
+        COLLECTIVE (``_any_host_failed``): a step counts as failed when
+        ANY host failed it, all hosts retry/fall back/quarantine in
+        lockstep, and a host whose local restore succeeded discards the
+        result rather than diverge — per-host divergence here would
+        wedge the slice in its next collective."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
             return state_like, None
-        logger.info("resuming from checkpoint step %d in %s", step,
-                    self.directory)
-        return self.restore(state_like, step), step
+        first_err: Optional[Exception] = None
+        failed: list = []
+        for step in steps:
+            out = err = None
+            restored = False
+            for restore_try in range(2):
+                try:
+                    out = self.restore(state_like, step)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    err = e
+                if not self._any_host_failed(err is not None):
+                    restored = True
+                    break
+                if err is None:
+                    # this host restored fine but another did not — the
+                    # verdict is collective, so align with the failure
+                    err = CheckpointRestoreError(
+                        f"step {step} failed to restore on another host")
+                out = None
+                if restore_try == 0:
+                    logger.warning(
+                        "restore of step %d failed (%s: %s); retrying "
+                        "once before treating it as corrupt", step,
+                        type(err).__name__, err)
+                    err = None
+            if not restored:
+                first_err = first_err if first_err is not None else err
+                failed.append((step, err))
+                continue
+            for bad, bad_err in failed:
+                logger.warning(
+                    "checkpoint step %d is unrestorable (%s: %s); "
+                    "quarantining it and resuming from step %d",
+                    bad, type(bad_err).__name__, bad_err, step)
+                self._quarantine(bad)
+            logger.info("resuming from checkpoint step %d in %s", step,
+                        self.directory)
+            return out, step
+        raise first_err
 
     def wait(self) -> None:
         """Block until async saves are durable (call before process exit)."""

@@ -2,3 +2,5 @@ from gke_ray_train_tpu.rayint.trainer import (  # noqa: F401
     JaxTrainer, ScalingConfig, RunConfig, FailureConfig, Result)
 from gke_ray_train_tpu.rayint.context import (  # noqa: F401
     get_context, report)
+from gke_ray_train_tpu.rayint.supervisor import (  # noqa: F401
+    HeartbeatBoard, HeartbeatTimeout, Supervisor, Watchdog)

@@ -17,6 +17,12 @@ logger = logging.getLogger(__name__)
 class TrainContext:
     def __init__(self):
         self.last_reported: Optional[dict] = None
+        # step the current attempt resumed from (None = fresh start);
+        # set by the train loop, read into Result.attempt_log
+        self.resumed_step: Optional[int] = None
+        # heartbeat sink wired by the trainer: callable(rank, step, done)
+        # forwarding to the supervisor actor (Ray) or the local board
+        self._heartbeat = None
 
     def get_world_size(self) -> int:
         return int(os.environ.get("NUM_PROCESSES", "1"))
@@ -29,6 +35,26 @@ class TrainContext:
 
     def is_host0(self) -> bool:
         return self.get_world_rank() == 0
+
+    def set_heartbeat_sink(self, fn) -> None:
+        self._heartbeat = fn
+
+    def heartbeat(self, step: int, done: bool = False) -> None:
+        """Report step progress to the supervisor (rayint/supervisor.py).
+        Best-effort: liveness reporting must never kill a live worker."""
+        if self._heartbeat is None:
+            return
+        try:
+            self._heartbeat(self.get_world_rank(), int(step), done)
+        except Exception as e:  # noqa: BLE001
+            logger.debug("heartbeat dropped: %s", e)
+
+    def heartbeat_done(self) -> None:
+        """Mark this rank finished — a done worker is never 'stalled'."""
+        self.heartbeat(-1, done=True)
+
+    def note_resume(self, step: Optional[int]) -> None:
+        self.resumed_step = step
 
     def report(self, metrics: dict, checkpoint_path: Optional[str] = None):
         """train.report parity: metrics become the trainer Result. Unlike

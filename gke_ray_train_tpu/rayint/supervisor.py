@@ -1,0 +1,164 @@
+"""Heartbeat supervision — step-granular liveness for training workers.
+
+``RunConfig.worker_timeout_s`` bounds a whole attempt with one wall
+clock, which forces the operator to guess total run time. This module
+detects the actual failure signature of a wedged collective or dead TPU
+host instead: *no step progress for N seconds* (``HEARTBEAT_TIMEOUT_S``).
+
+Workers report ``(rank, step)`` after every completed step through
+``rayint/context.py`` (``ctx.heartbeat``); the sink is wired by the
+trainer — a :class:`Supervisor` actor on Ray clusters, an in-process
+:class:`HeartbeatBoard` + :class:`Watchdog` thread in the local path.
+The driver polls for stalls and kills the attempt with an error that
+NAMES the stalled rank, so the operator learns which host to drain.
+
+Arrival times are stamped by the receiving board (driver/actor clock) —
+worker clocks are never trusted across machines. A rank is tracked only
+once it has beaten (model build/compile before the first step is not a
+stall; ``worker_timeout_s`` still bounds that phase if set) and is
+exempt once it reports done (a finished or failed worker is not a
+stalled one).
+
+Stdlib-only by design: importable by the driver-side trainer and the
+Ray actor runtime without jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# (rank, last_step, seconds_since_last_progress)
+StallInfo = Tuple[int, int, float]
+
+
+class HeartbeatTimeout(RuntimeError):
+    """An attempt killed because a named rank stopped making step
+    progress. Retryable: workers resume from the latest checkpoint."""
+
+    def __init__(self, stalled: List[StallInfo], timeout_s: float):
+        self.stalled = list(stalled)
+        self.timeout_s = timeout_s
+        ranks = ", ".join(
+            f"rank {r} (last step {s}, {age:.1f}s ago)"
+            for r, s, age in self.stalled)
+        super().__init__(
+            f"heartbeat timeout: no step progress for {timeout_s:g}s "
+            f"from {ranks}; killed all workers for retry-with-resume")
+
+
+class HeartbeatBoard:
+    """Thread-safe rank → (step, arrival_time-of-last-PROGRESS) board.
+
+    A beat only refreshes the clock when the step advanced — a worker
+    re-reporting the same step is as stalled as one reporting nothing.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._last = {}      # rank -> (step, monotonic_time)
+        self._done = set()
+
+    def beat(self, rank: int, step: int, done: bool = False) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if done:
+                self._done.add(rank)
+                return
+            prev = self._last.get(rank)
+            if prev is None or step > prev[0]:
+                self._last[rank] = (int(step), now)
+
+    def stalled(self, timeout_s: float,
+                now: Optional[float] = None) -> List[StallInfo]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return sorted(
+                (rank, step, now - t)
+                for rank, (step, t) in self._last.items()
+                if rank not in self._done and now - t > timeout_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {rank: {"step": step, "age_s": time.monotonic() - t,
+                           "done": rank in self._done}
+                    for rank, (step, t) in self._last.items()}
+
+
+class Supervisor:
+    """Actor body for the Ray path: ``ray.remote(Supervisor)`` in the
+    trainer (decorating here would make Ray an import-time dependency).
+    Workers fire-and-forget ``beat``; the driver polls ``stalled``."""
+
+    def __init__(self):
+        self._board = HeartbeatBoard()
+
+    def beat(self, rank: int, step: int, done: bool = False) -> None:
+        self._board.beat(rank, step, done=done)
+
+    def stalled(self, timeout_s: float) -> List[StallInfo]:
+        return self._board.stalled(timeout_s)
+
+    def snapshot(self) -> dict:
+        return self._board.snapshot()
+
+
+class Watchdog:
+    """Local-path supervision: a daemon thread polling a board.
+
+    On stall it records ``stalled_info`` and interrupts the main thread
+    (the worker shares our process — a wedged collective ignores
+    everything short of an interrupt); ``JaxTrainer._fit_local``
+    converts that KeyboardInterrupt into :class:`HeartbeatTimeout`.
+    """
+
+    def __init__(self, board: HeartbeatBoard, timeout_s: float,
+                 poll_s: Optional[float] = None,
+                 on_stall: Optional[Callable] = None):
+        self.board = board
+        self.timeout_s = timeout_s
+        self.poll_s = poll_s if poll_s is not None else max(
+            0.01, min(timeout_s / 4.0, 5.0))
+        self.stalled_info: Optional[List[StallInfo]] = None
+        self._on_stall = on_stall
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="heartbeat-watchdog")
+
+    def start(self) -> "Watchdog":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            stalled = self.board.stalled(self.timeout_s)
+            if stalled:
+                # confirmation read: a worker finishing exactly at the
+                # detection boundary marks itself done in between — a
+                # completed attempt must not be interrupted and retried
+                time.sleep(min(0.05, self.poll_s))
+                stalled = self.board.stalled(self.timeout_s)
+            # re-check stop right before acting: a worker that completed
+            # while we computed the stall must not be interrupted
+            if stalled and not self._stop.is_set():
+                self.stalled_info = stalled
+                logger.error("%s", HeartbeatTimeout(stalled, self.timeout_s))
+                if self._on_stall is not None:
+                    self._on_stall(stalled)
+                else:
+                    # a real SIGINT to the process: unlike
+                    # _thread.interrupt_main(), it EINTRs a main thread
+                    # blocked in C (time.sleep, a dead collective's
+                    # syscall) instead of waiting for its next bytecode
+                    import os
+                    import signal
+                    os.kill(os.getpid(), signal.SIGINT)
+                return

@@ -16,6 +16,25 @@ run_config).fit() → Result(metrics)``. Differences, by design
   never configures it, §5.3); retried workers resume from the latest
   orbax checkpoint because every entry script restores-if-present.
 
+Fault-tolerance model (one PR, three failure classes):
+
+- **Genuine failures** (crash, InjectedKill, heartbeat/worker timeout)
+  consume the ``max_failures`` budget and retry with exponential
+  backoff + jitter.
+- **Preemptions** (SIGTERM → ``train/preempt.py`` → the loop
+  checkpoints and raises ``Preempted``) do NOT consume ``max_failures``
+  — a spot eviction is not the job's fault — and are bounded by
+  ``FailureConfig.max_preemptions`` instead.
+- **Non-retryable errors** (KeyError/ValueError/TypeError/... — a
+  config typo fails identically every attempt) fail fast on the first
+  attempt with the original traceback in the log.
+
+Liveness is supervised at step granularity when
+``RunConfig.heartbeat_timeout_s`` is set (``rayint/supervisor.py``):
+workers report per-step heartbeats, and a rank with no step progress
+for that long is killed BY NAME — versus ``worker_timeout_s``, which
+only bounds the whole attempt's wall clock.
+
 Ray is optional at import time: with no Ray installed (or
 ``use_ray=False``) the trainer degrades to a single in-process worker —
 that is also the unit-test path.
@@ -26,6 +45,8 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import random
+import time
 from typing import Any, Callable, Dict, Optional
 
 logger = logging.getLogger(__name__)
@@ -38,6 +59,18 @@ except ImportError:
     _HAS_RAY = False
 
 DEFAULT_COORDINATOR_PORT = 8476  # fallback when port discovery fails
+
+# deterministic errors: retrying replays the identical failure N times
+# and buries the real traceback under repetition. Matched by type AND
+# name (Ray's serialized task errors may rebuild exception instances).
+NONRETRYABLE_TYPES = (KeyError, ValueError, TypeError, AttributeError,
+                      ImportError, NotImplementedError)
+_NONRETRYABLE_NAMES = frozenset(t.__name__ for t in NONRETRYABLE_TYPES) | {
+    "ModuleNotFoundError"}
+# explicitly-retryable markers override the type match: a collective
+# checkpoint-restore failure is often a ValueError underneath
+# (orbax/tensorstore), but a fresh attempt re-reads storage
+_RETRYABLE_NAMES = frozenset({"CheckpointRestoreError"})
 
 
 @dataclasses.dataclass
@@ -64,7 +97,13 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
+    # genuine-failure retry budget (crashes, hangs, timeouts)
     max_failures: int = 0
+    # separate budget for spot/preemptible evictions: a preemption
+    # checkpoints within its grace window and resumes, so it must not
+    # burn a max_failures slot — but unbounded preemption churn on a
+    # doomed node pool still needs a stop
+    max_preemptions: int = 8
 
 
 @dataclasses.dataclass
@@ -80,6 +119,16 @@ class RunConfig:
     # failure, so retry-with-resume proceeds. None = wait forever (the
     # default: legitimate training runs have no universal time bound).
     worker_timeout_s: Optional[float] = None
+    # Step-granular liveness (rayint/supervisor.py): kill the attempt —
+    # naming the stalled rank — when a worker reports no step progress
+    # for this long. Orthogonal to worker_timeout_s: this bounds the
+    # gap BETWEEN steps, not the run. None = no heartbeat supervision.
+    heartbeat_timeout_s: Optional[float] = None
+    # base of the exponential backoff between genuine-failure retries
+    # (delay = base * 2^(failures-1), capped at 60s, x jitter in
+    # [0.5, 1.5)). None = $RETRY_BACKOFF_S or 1.0. Preemptions resume
+    # immediately — their checkpoint is already durable.
+    retry_backoff_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -89,14 +138,71 @@ class Result:
     # per-worker metrics (worker 0 first); `metrics` is worker 0's view,
     # matching Ray Train's rank-0 convention, but nothing is dropped
     worker_metrics: Optional[list] = None
+    # attempt metadata: "ok" | "failed" | "preempted" (budget exhausted)
+    status: str = "ok"
+    attempts: int = 1
+    preemptions: int = 0
+    # one dict per attempt: {"status", "error"?, "step"?, "resumed_step"?,
+    # "ckpt_save_s"?, "nonretryable"?}
+    attempt_log: list = dataclasses.field(default_factory=list)
 
 
-def _run_worker(fn: Callable, config: dict, env: Dict[str, str]):
+def _cause_chain(e: BaseException):
+    """Walk explicit causes only (ray's .cause / raise-from __cause__) —
+    __context__ drags in unrelated already-handled exceptions."""
+    seen = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        yield e
+        e = getattr(e, "cause", None) or e.__cause__
+
+
+def _find_preempted(e: BaseException):
+    from gke_ray_train_tpu.train.preempt import Preempted
+    for x in _cause_chain(e):
+        if isinstance(x, Preempted) or type(x).__name__ == "Preempted":
+            return x
+    return None
+
+
+def _is_nonretryable(e: BaseException) -> bool:
+    for x in _cause_chain(e):
+        # walked outermost-in: a retryable wrapper vouches for whatever
+        # deterministic-looking cause sits beneath it
+        if type(x).__name__ in _RETRYABLE_NAMES:
+            return False
+        if isinstance(x, NONRETRYABLE_TYPES) \
+                or type(x).__name__ in _NONRETRYABLE_NAMES:
+            return True
+    return False
+
+
+def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
+                beat_fn: Optional[Callable] = None) -> dict:
+    """Returns {"metrics", "resumed_step"} — the resume step rides the
+    payload because on the Ray path the worker context lives in another
+    process and the driver could not read it otherwise."""
     os.environ.update(env)
     from gke_ray_train_tpu.rayint.context import get_context
-    ret = fn(config)
-    reported = get_context().last_reported
-    return ret if ret is not None else (reported or {})
+    from gke_ray_train_tpu.train import preempt
+    ctx = get_context()
+    ctx.resumed_step = None      # fresh attempt, fresh metadata
+    ctx.set_heartbeat_sink(beat_fn)
+    preempt.reset()              # a retry must not inherit the previous
+    preempt.install()            # attempt's preemption flag
+    try:
+        ret = fn(config)
+        reported = ctx.last_reported
+        return {"metrics": ret if ret is not None else (reported or {}),
+                "resumed_step": ctx.resumed_step}
+    finally:
+        # a finished (or failed — its error surfaces via the future)
+        # worker must never be reported as stalled
+        ctx.heartbeat_done()
+        # restore the default SIGTERM disposition: outside an attempt
+        # nothing reads the preemption flag, and a long-lived driver
+        # process must not silently swallow termination
+        preempt.uninstall()
 
 
 class JaxTrainer:
@@ -123,13 +229,61 @@ class JaxTrainer:
                         if use_ray is None else use_ray)
 
     # -- local ---------------------------------------------------------
-    def _fit_local(self) -> Result:
+    def _fit_local(self) -> tuple:
+        from gke_ray_train_tpu.rayint.context import get_context
+        from gke_ray_train_tpu.rayint.supervisor import (
+            HeartbeatBoard, HeartbeatTimeout, Watchdog)
         env = {"NUM_PROCESSES": "1", "PROCESS_ID": "0"}
-        metrics = _run_worker(self.fn, self.config, env)
-        return Result(metrics=metrics)
+        hb = self.run_config.heartbeat_timeout_s
+        board = HeartbeatBoard() if hb else None
+        wd = Watchdog(board, hb).start() if hb else None
+        # the outer try also covers the cleanup and the return: a
+        # watchdog SIGINT raised while the finally runs (worker finished
+        # in the detection race window) must still be translated, not
+        # escape fit() as a raw KeyboardInterrupt
+        try:
+            try:
+                out = _run_worker(self.fn, self.config, env,
+                                  beat_fn=board.beat if board else None)
+            finally:
+                if wd is not None:
+                    wd.stop()
+                get_context().set_heartbeat_sink(None)
+            return Result(metrics=out["metrics"]), out["resumed_step"]
+        except KeyboardInterrupt:
+            # the watchdog interrupts the main thread on stall (the only
+            # way to pry a single process out of a wedged collective);
+            # translate it — a real Ctrl-C (no stall recorded) re-raises
+            if wd is not None and wd.stalled_info:
+                raise HeartbeatTimeout(wd.stalled_info, hb) from None
+            raise
 
     # -- ray ----------------------------------------------------------
-    def _fit_ray(self) -> Result:
+    @staticmethod
+    def _kill_workers(workers) -> None:
+        for w in workers:
+            try:
+                ray.kill(w)
+            except Exception:  # noqa: BLE001
+                pass
+
+    @staticmethod
+    def _get_result(future, rank: int, ips: list):
+        """ray.get with per-rank error attribution: a worker exception
+        re-raises naming the failing rank and its node IP ("a worker
+        died" is undebuggable on a slice); Preempted passes through
+        untouched for fit()'s classification."""
+        try:
+            return ray.get(future)
+        except Exception as e:  # noqa: BLE001
+            if _find_preempted(e) is not None:
+                raise
+            cause = getattr(e, "cause", None) or e.__cause__ or e
+            raise RuntimeError(
+                f"worker rank {rank} (node {ips[rank]}) failed: "
+                f"{type(cause).__name__}: {cause}") from e
+
+    def _fit_ray(self) -> tuple:
         if not ray.is_initialized():
             ray.init(address=os.environ.get("RAY_ADDRESS", "auto"))
         n = self.scaling.num_workers
@@ -153,8 +307,22 @@ class JaxTrainer:
                 s.close()
                 return port
 
-            def run(self, fn, config, env):
-                return _run_worker(fn, config, env)
+            def run(self, fn, config, env, supervisor=None):
+                beat = None
+                if supervisor is not None:
+                    def beat(rank, step, done):
+                        # fire-and-forget: the worker never blocks on
+                        # its own liveness report
+                        supervisor.beat.remote(rank, step, done)
+                return _run_worker(fn, config, env, beat_fn=beat)
+
+        hb_timeout = self.run_config.heartbeat_timeout_s
+        supervisor = None
+        if hb_timeout:
+            from gke_ray_train_tpu.rayint.supervisor import (
+                HeartbeatTimeout, Supervisor)
+            # tiny bookkeeping actor; released with its handle at return
+            supervisor = ray.remote(Supervisor).options(num_cpus=0).remote()
 
         # honor placement_strategy: one bundle per worker, SPREAD puts
         # each TPU worker on its own host (the declared-but-unused
@@ -179,48 +347,94 @@ class JaxTrainer:
                 Worker.options(resources=resources, num_cpus=num_cpus,
                                scheduling_strategy=sched(i)).remote()
                 for i in range(n)]
-            coord_ip = ray.get(workers[0].node_ip.remote())
+            # all node IPs up front: worker 0's elects the coordinator,
+            # the rest name the failing host in errors
+            ips = ray.get([w.node_ip.remote() for w in workers])
+            coord_ip = ips[0]
             coord_port = None
-            for _ in range(3):   # transient RPC/bind failures retry
+            for port_try in range(3):
                 try:
                     coord_port = int(ray.get(workers[0].free_port.remote()))
                     break
-                except Exception:  # noqa: BLE001
-                    continue
+                except Exception as e:  # noqa: BLE001 - transient RPC/bind
+                    logger.warning(
+                        "coordinator port discovery attempt %d/3 failed "
+                        "(%s: %s); retrying", port_try + 1,
+                        type(e).__name__, e)
+                    time.sleep(0.2 * (2 ** port_try))
             if coord_port is None:
                 coord_port = DEFAULT_COORDINATOR_PORT
+                logger.error(
+                    "coordinator port discovery failed after 3 attempts; "
+                    "FALLING BACK to fixed port %d — this COLLIDES when "
+                    "another job's coordinator shares the node",
+                    DEFAULT_COORDINATOR_PORT)
             env_base = {
                 "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
                 "NUM_PROCESSES": str(n),
             }
             futures = [
                 w.run.remote(self.fn, self.config,
-                             {**env_base, "PROCESS_ID": str(i)})
+                             {**env_base, "PROCESS_ID": str(i)}, supervisor)
                 for i, w in enumerate(workers)]
             timeout = self.run_config.worker_timeout_s
-            if timeout is not None:
-                # hang detection: a worker stuck in a dead collective
-                # never returns, so ray.get alone would block fit()
-                # forever and FailureConfig.max_failures would never
-                # trigger. Bound the attempt, surface WHICH workers
-                # stalled, kill everything, and raise into the retry
-                # loop (workers resume from the latest checkpoint).
-                done, pending = ray.wait(futures,
-                                         num_returns=len(futures),
-                                         timeout=timeout)
-                if pending:
-                    stalled = sorted(i for i, f in enumerate(futures)
-                                     if f in pending)
-                    for w in workers:
+            if timeout is not None or supervisor is not None:
+                # supervised wait: poll for completion while checking
+                # (a) step-granular heartbeat stalls — a wedged
+                # collective or dead host is caught HEARTBEAT_TIMEOUT_S
+                # after its last step, named by rank — and (b) the
+                # whole-attempt wall-clock bound. Either kills every
+                # worker and raises into the retry loop (workers resume
+                # from the latest checkpoint).
+                deadline = (time.monotonic() + timeout
+                            if timeout is not None else None)
+                # timeout=0 means "expire immediately", not "no bound" —
+                # it must not reach min() as an empty candidate set
+                slices = [t / 4.0 for t in (timeout, hb_timeout)
+                          if t is not None and t > 0]
+                poll_s = max(0.005, min(min(slices, default=5.0), 5.0))
+                while True:
+                    done, pending = ray.wait(futures,
+                                             num_returns=len(futures),
+                                             timeout=poll_s)
+                    if not pending:
+                        break
+                    # a crashed rank completes-with-error while its
+                    # collective partners wedge (and, pre-first-step,
+                    # never even arm supervision) — the crash is the
+                    # ROOT CAUSE and must surface NOW, on every poll,
+                    # or a heartbeat-only config hangs forever hiding
+                    # it. Preempted completions are NOT raised here:
+                    # the other ranks are mid-grace-window-save and
+                    # must be allowed to finish before collection.
+                    for i, f in enumerate(futures):
+                        if f not in done:
+                            continue
                         try:
-                            ray.kill(w)
-                        except Exception:  # noqa: BLE001
-                            pass
-                    raise TimeoutError(
-                        f"worker(s) {stalled} still running after "
-                        f"{timeout}s (others done: {len(done)}/{n}); "
-                        "killed all workers for retry-with-resume")
-            results = ray.get(futures)
+                            ray.get(f)
+                        except Exception as e:  # noqa: BLE001
+                            if _find_preempted(e) is not None:
+                                continue
+                            self._kill_workers(workers)
+                            self._get_result(f, i, ips)  # raises wrapped
+                    if supervisor is not None:
+                        stalled = ray.get(
+                            supervisor.stalled.remote(hb_timeout))
+                        if stalled:
+                            self._kill_workers(workers)
+                            raise HeartbeatTimeout(stalled, hb_timeout)
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        stalled_idx = sorted(
+                            i for i, f in enumerate(futures)
+                            if f in pending)
+                        self._kill_workers(workers)
+                        raise TimeoutError(
+                            f"worker(s) {stalled_idx} still running after "
+                            f"{timeout}s (others done: {len(done)}/{n}); "
+                            "killed all workers for retry-with-resume")
+            results = [self._get_result(f, i, ips)
+                       for i, f in enumerate(futures)]
         finally:
             # PGs outlive their Python handles; without removal a retry
             # attempt would create a second PG against resources the
@@ -229,19 +443,86 @@ class JaxTrainer:
                 ray.util.remove_placement_group(pg)
             except Exception:  # noqa: BLE001 - cleanup is best-effort
                 pass
-        return Result(metrics=results[0] if results else {},
-                      worker_metrics=list(results))
+        return Result(
+            metrics=results[0]["metrics"] if results else {},
+            worker_metrics=[r["metrics"] for r in results]), \
+            (results[0]["resumed_step"] if results else None)
 
     def fit(self) -> Result:
-        attempts = self.run_config.failure_config.max_failures + 1
-        last_err: Optional[Exception] = None
-        for attempt in range(attempts):
+        fc = self.run_config.failure_config
+        backoff_base = self.run_config.retry_backoff_s
+        if backoff_base is None:
+            backoff_base = float(os.environ.get("RETRY_BACKOFF_S", "1.0"))
+        failures = 0
+        preemptions = 0
+        attempt = 0
+        attempt_log: list = []
+        while True:
+            attempt += 1
             try:
-                if self.use_ray:
-                    return self._fit_ray()
-                return self._fit_local()
-            except Exception as e:  # noqa: BLE001 - retry-with-resume path
-                last_err = e
+                result, resumed_step = self._fit_ray() if self.use_ray \
+                    else self._fit_local()
+                attempt_log.append({
+                    "status": "ok", "resumed_step": resumed_step})
+                result.attempts = attempt
+                result.preemptions = preemptions
+                result.attempt_log = attempt_log
+                return result
+            except Exception as e:  # noqa: BLE001 - classified below
+                p = _find_preempted(e)
+                if p is not None:
+                    # preempted: checkpointed within the grace window and
+                    # exited cleanly — not a failure, does NOT consume
+                    # max_failures; bounded by its own budget
+                    preemptions += 1
+                    attempt_log.append({
+                        "status": "preempted",
+                        "step": getattr(p, "step", None),
+                        "resumed_step": getattr(p, "resumed_step", None),
+                        "ckpt_save_s": getattr(p, "save_s", None)})
+                    if preemptions > fc.max_preemptions:
+                        logger.error(
+                            "preemption budget exhausted "
+                            "(max_preemptions=%d): %s",
+                            fc.max_preemptions, e)
+                        return Result(
+                            metrics={}, error=str(e), status="preempted",
+                            attempts=attempt, preemptions=preemptions,
+                            attempt_log=attempt_log)
+                    logger.warning(
+                        "attempt %d preempted (%s); resuming from the "
+                        "saved checkpoint (preemption %d/%d; max_failures "
+                        "budget untouched)", attempt, e, preemptions,
+                        fc.max_preemptions)
+                    continue  # immediate: the checkpoint is durable
+                if _is_nonretryable(e):
+                    logger.exception(
+                        "attempt %d failed with non-retryable %s; NOT "
+                        "retrying (a deterministic error fails "
+                        "identically every attempt)", attempt,
+                        type(e).__name__)
+                    attempt_log.append({"status": "failed",
+                                        "error": str(e),
+                                        "nonretryable": True})
+                    return Result(metrics={}, error=str(e),
+                                  status="failed", attempts=attempt,
+                                  preemptions=preemptions,
+                                  attempt_log=attempt_log)
+                failures += 1
+                attempt_log.append({"status": "failed", "error": str(e)})
                 logger.exception(
-                    "training attempt %d/%d failed", attempt + 1, attempts)
-        return Result(metrics={}, error=str(last_err))
+                    "training attempt %d failed (failure %d/%d)",
+                    attempt, failures, fc.max_failures)
+                if failures > fc.max_failures:
+                    return Result(metrics={}, error=str(e),
+                                  status="failed", attempts=attempt,
+                                  preemptions=preemptions,
+                                  attempt_log=attempt_log)
+                # exponential backoff + jitter: a mass restart (whole
+                # slice lost) must not thundering-herd the coordinator
+                delay = min(backoff_base * (2 ** (failures - 1)), 60.0)
+                delay *= 0.5 + random.random()
+                if delay > 0:
+                    logger.info("retrying in %.1fs (backoff + jitter)",
+                                delay)
+                    time.sleep(delay)

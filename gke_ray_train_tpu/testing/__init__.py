@@ -1,0 +1,2 @@
+from gke_ray_train_tpu.testing.faults import (  # noqa: F401
+    FaultInjector, FaultSpec, InjectedKill, parse_fault_spec, reset_fired)

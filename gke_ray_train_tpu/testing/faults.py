@@ -1,0 +1,229 @@
+"""Deterministic fault injection — the testable half of fault tolerance.
+
+None of the failure handling (retry-with-resume, graceful preemption,
+corrupt-checkpoint fallback, heartbeat supervision) is trustworthy
+unless a test can *cause* each failure at an exact step. ``FAULT_SPEC``
+is that cause: an env/config grammar the train loop honors at every
+step boundary, identical on the local path, the Ray worker path, and
+the real multi-process harness (``tests/_multihost.py`` — the env
+propagates to every worker process).
+
+Grammar — ``;``-separated entries of ``:``-separated ``key=value``
+fields::
+
+    FAULT_SPEC="rank=1:kind=kill:step=5;rank=*:kind=sigterm:step=8"
+
+Fields:
+
+- ``kind`` (required): ``kill`` (raise — the worker process dies),
+  ``hang`` (sleep ``seconds`` — a wedged collective), ``sigterm``
+  (deliver a preemption, ``train/preempt.py``), ``ckpt_truncate``
+  (corrupt the newest checkpoint step on disk — an interrupted async
+  save's torn tail).
+- ``step`` (required int): global step AFTER which the fault fires
+  (the loop calls ``on_step`` once per completed step).
+- ``rank`` (int or ``*``, default ``*``): which worker fires it.
+- ``seconds`` (float, ``hang`` only, default 3600): hang duration —
+  finite so an undetected hang still ends, but far beyond any
+  reasonable ``HEARTBEAT_TIMEOUT_S``.
+
+Each entry fires at most once per RUN, mirroring a real one-shot
+hardware event: the fired-registry is module-global (an in-process
+retry — the ``JaxTrainer`` local path — does not re-fire) AND, when a
+checkpoint manager is bound, persisted as a marker file beside the
+checkpoints — so on a real Ray cluster, where every retry attempt is a
+FRESH actor process that re-reaches the fault step after resume, the
+fault still fires exactly once. Tests call :func:`reset_fired` between
+cases (fresh tmp checkpoint dirs take care of the marker file).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import logging
+import os
+import time
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("kill", "hang", "sigterm", "ckpt_truncate")
+_FIELDS = ("rank", "kind", "step", "seconds")
+
+
+class InjectedKill(RuntimeError):
+    """A deliberately killed worker (retryable, like the real thing)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str
+    step: int
+    rank: str = "*"          # "*" or the decimal rank
+    seconds: float = 3600.0  # hang duration
+
+    def matches(self, rank: int, step: int) -> bool:
+        return self.step == step and (
+            self.rank == "*" or int(self.rank) == rank)
+
+
+def parse_fault_spec(spec: str) -> List[FaultSpec]:
+    """Parse the FAULT_SPEC grammar; raises ValueError on anything it
+    does not understand (a typo'd fault must fail the test loudly, not
+    silently not-fire)."""
+    out = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        fields = {}
+        for part in entry.split(":"):
+            if "=" not in part:
+                raise ValueError(
+                    f"FAULT_SPEC field {part!r} is not key=value "
+                    f"(entry {entry!r})")
+            k, v = part.split("=", 1)
+            if k not in _FIELDS:
+                raise ValueError(
+                    f"FAULT_SPEC unknown field {k!r} (entry {entry!r}); "
+                    f"known: {_FIELDS}")
+            fields[k] = v
+        if "kind" not in fields or "step" not in fields:
+            raise ValueError(
+                f"FAULT_SPEC entry {entry!r} needs kind= and step=")
+        if fields["kind"] not in KINDS:
+            raise ValueError(
+                f"FAULT_SPEC unknown kind {fields['kind']!r}; "
+                f"known: {KINDS}")
+        rank = fields.get("rank", "*")
+        if rank != "*":
+            int(rank)  # fail fast on garbage
+        out.append(FaultSpec(
+            kind=fields["kind"], step=int(fields["step"]), rank=rank,
+            seconds=float(fields.get("seconds", 3600.0))))
+    return out
+
+
+# process-global so an in-process retry attempt (which re-creates the
+# injector from env) does not re-fire an already-fired fault; the
+# marker file below extends the guarantee across worker processes
+_FIRED = set()
+
+MARKER_NAME = ".fault_spec_fired"
+
+
+def reset_fired() -> None:
+    _FIRED.clear()
+
+
+class FaultInjector:
+    """Step-boundary hook the train loop calls (``on_step``)."""
+
+    def __init__(self, specs: List[FaultSpec], *, rank: int = 0,
+                 ckpt_manager=None):
+        self.specs = list(specs)
+        self.rank = int(rank)
+        self.ckpt_manager = ckpt_manager
+
+    @staticmethod
+    def from_env(rank: Optional[int] = None,
+                 ckpt_manager=None) -> Optional["FaultInjector"]:
+        """Injector from $FAULT_SPEC, or None when unset (the production
+        default — zero overhead beyond this one env read)."""
+        raw = os.environ.get("FAULT_SPEC", "").strip()
+        if not raw:
+            return None
+        if rank is None:
+            rank = int(os.environ.get("PROCESS_ID", "0"))
+        return FaultInjector(parse_fault_spec(raw), rank=rank,
+                             ckpt_manager=ckpt_manager)
+
+    def bind_ckpt(self, ckpt_manager) -> None:
+        if self.ckpt_manager is None:
+            self.ckpt_manager = ckpt_manager
+
+    def _marker_path(self) -> Optional[str]:
+        if self.ckpt_manager is None:
+            return None
+        return os.path.join(str(self.ckpt_manager.directory), MARKER_NAME)
+
+    def _marker_key(self, spec: FaultSpec) -> str:
+        return f"rank{self.rank}:{spec.kind}@{spec.step}:match={spec.rank}"
+
+    def _already_fired(self, spec: FaultSpec) -> bool:
+        if (self.rank, spec) in _FIRED:
+            return True
+        path = self._marker_path()
+        if path is None:
+            return False
+        try:
+            with open(path) as f:
+                return self._marker_key(spec) in f.read().splitlines()
+        except OSError:  # no marker yet
+            return False
+
+    def _mark_fired(self, spec: FaultSpec) -> None:
+        _FIRED.add((self.rank, spec))
+        path = self._marker_path()
+        if path is None:
+            return
+        try:
+            # shared storage beside the checkpoints: a retried attempt
+            # on a FRESH worker process (real Ray) must also see the
+            # fault as spent
+            with open(path, "a") as f:
+                f.write(self._marker_key(spec) + "\n")
+        except OSError as e:  # pragma: no cover - marker is best-effort
+            logger.debug("could not persist fired-fault marker: %s", e)
+
+    def on_step(self, step: int) -> None:
+        for spec in self.specs:
+            if spec.matches(self.rank, step) and \
+                    not self._already_fired(spec):
+                self._mark_fired(spec)
+                self._fire(spec, step)
+
+    def _fire(self, spec: FaultSpec, step: int) -> None:
+        logger.warning("FAULT_SPEC firing kind=%s at step %d (rank %d)",
+                       spec.kind, step, self.rank)
+        if spec.kind == "kill":
+            raise InjectedKill(
+                f"injected kill at step {step} (rank {self.rank})")
+        if spec.kind == "hang":
+            time.sleep(spec.seconds)
+        elif spec.kind == "sigterm":
+            from gke_ray_train_tpu.train import preempt
+            preempt.trigger()
+        elif spec.kind == "ckpt_truncate":
+            self._truncate_latest(step)
+
+    def _truncate_latest(self, step: int) -> None:
+        """Tear the newest checkpoint step the way an interrupted async
+        save does: cut the largest data file in half. Restore of this
+        step must subsequently fail (ckpt/manager.py falls back)."""
+        mgr = self.ckpt_manager
+        if mgr is None:
+            raise RuntimeError(
+                "FAULT_SPEC kind=ckpt_truncate needs a checkpoint "
+                "manager bound to the injector (run with checkpointing "
+                "enabled)")
+        mgr.wait()  # the torn tail must be of a COMMITTED save
+        latest = mgr.latest_step()
+        if latest is None:
+            raise RuntimeError(
+                f"FAULT_SPEC ckpt_truncate at step {step}: no checkpoint "
+                "saved yet (schedule the fault after a save step)")
+        step_dir = os.path.join(str(mgr.directory), str(latest))
+        files = [f for f in glob.glob(os.path.join(step_dir, "**", "*"),
+                                      recursive=True) if os.path.isfile(f)]
+        if not files:
+            raise RuntimeError(f"ckpt_truncate: no files under {step_dir}")
+        files.sort(key=os.path.getsize, reverse=True)
+        target = files[0]
+        size = os.path.getsize(target)
+        with open(target, "r+b") as f:
+            f.truncate(max(1, size // 2))
+        logger.warning(
+            "truncated %s (%d -> %d bytes): checkpoint step %d is now a "
+            "corrupt tail", target, size, max(1, size // 2), latest)

@@ -18,12 +18,15 @@ context (:296-310). Differences by design:
 from __future__ import annotations
 
 import logging
+import os
+import time
 from typing import Callable, Iterable, Optional
 
 import jax
 import numpy as np
 
 from gke_ray_train_tpu.data.prefetch import make_batch_source
+from gke_ray_train_tpu.train import preempt
 from gke_ray_train_tpu.train.metrics import ThroughputMeter, paused
 from gke_ray_train_tpu.train.step import TrainState
 
@@ -49,6 +52,8 @@ def run_training(state: TrainState,
                  ckpt_view: Optional[tuple] = None,
                  profiler=None,
                  tb_writer=None,
+                 heartbeat_fn: Optional[Callable] = None,
+                 fault_injector=None,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
@@ -68,9 +73,31 @@ def run_training(state: TrainState,
     optimizer state (the frozen/quantized base is rebuilt from the
     pretrained weights on resume, and quantized uint4 codes are not
     serializable anyway).
+    heartbeat_fn(step, done=False) → per-step liveness report
+    (rayint/supervisor.py; entry scripts wire ctx.heartbeat). Called
+    after every completed step — supervision arms at the first beat,
+    so first-step compile and resume fast-forward are not stalls; the
+    done=True call at loop exit exempts this rank from stall detection
+    (post-loop export work is unsupervised by design). Size
+    HEARTBEAT_TIMEOUT_S above the longest eval/checkpoint pause: the
+    clock only refreshes on step ADVANCE.
+    fault_injector: deterministic fault hook fired once per completed
+    step (testing/faults.py). None = built from $FAULT_SPEC, which is
+    unset in production — the env read is the only overhead.
+
+    Preemption (train/preempt.py): when the SIGTERM flag is up at a
+    step boundary the loop force-saves a checkpoint, waits until it is
+    durable, and raises Preempted — the trainer retries WITHOUT
+    consuming the max_failures budget.
     """
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
     load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
+    if fault_injector is None:
+        from gke_ray_train_tpu.testing.faults import FaultInjector
+        fault_injector = FaultInjector.from_env(ckpt_manager=ckpt_manager)
+    elif ckpt_manager is not None:
+        fault_injector.bind_ckpt(ckpt_manager)
+    resumed_step = None
     if ckpt_manager is not None:
         try:
             view, resumed = ckpt_manager.restore_if_available(
@@ -92,17 +119,91 @@ def run_training(state: TrainState,
                 state = full
         if resumed is not None and is_host0:
             logger.info("resumed at step %d", resumed)
+        resumed_step = resumed
+        # attempt metadata for Result.attempt_log (rayint/trainer.py);
+        # context is stdlib-only, so this costs nothing standalone
+        from gke_ray_train_tpu.rayint.context import get_context
+        get_context().note_resume(resumed)
 
     last_metrics = {}
     global_step = int(jax.device_get(state.step))
+
+    n_procs = max(jax.process_count(), 1)
+    # multi-host flag agreement runs only every K-th boundary: blocking
+    # on a cross-host collective EVERY step would serialize the async
+    # dispatch overlap the input pipeline exists for. K is uniform
+    # across hosts, so ranks still agree on the exit step; worst-case
+    # exit delay is K steps against the ~25s grace window.
+    preempt_sync_every = max(
+        1, int(os.environ.get("PREEMPT_SYNC_EVERY", "4")))
+    _boundary = [0]
+
+    def _preempt_requested() -> bool:
+        """Collective preemption verdict. SIGTERM lands on every host of
+        an evicted slice, but async dispatch skews the hosts' Python
+        loops by a step or two — a host exiting at ITS flag-observation
+        step would enter a forced save its peers never join and wedge
+        the slice inside the grace window. The allgather (a tiny host
+        collective, multi-host only) makes every rank exit at the SAME
+        boundary: any host's flag preempts all."""
+        local = preempt.requested()
+        if n_procs <= 1:
+            return local
+        _boundary[0] += 1
+        if _boundary[0] % preempt_sync_every:
+            # off-cycle boundaries never exit, even with the local flag
+            # up — exits happen only where every rank runs the collective
+            return False
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(
+            np.asarray(1 if local else 0, np.int32))
+        return bool(np.max(flags))
+
+    def _preempt_exit(state, m, step):
+        """Grace-window exit: force-save, wait until durable, raise the
+        distinct 'preempted' status (train/preempt.py)."""
+        save_s = None
+        if ckpt_manager is not None:
+            t0 = time.perf_counter()
+            if m is not None and ckpt_manager.latest_step() != step:
+                m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
+                ckpt_manager.save(step, save_view(state), metrics=m_host,
+                                  force=True)
+            ckpt_manager.wait()
+            save_s = time.perf_counter() - t0
+            kept = ckpt_manager.latest_step()
+            if kept != step:
+                # best-by-score retention can delete a forced save whose
+                # metric is not among the best — the resume then loses
+                # every step since the surviving checkpoint. Training
+                # managers should use recency retention (the entry
+                # scripts do); shout, because this is silent data loss.
+                logger.error(
+                    "preemption save at step %d was DROPPED by "
+                    "retention (surviving latest: %s) — the retry "
+                    "resumes from there; use score_attribute=None on "
+                    "resume managers", step, kept)
+            elif is_host0:
+                logger.warning(
+                    "preemption: checkpoint at step %d durable in %.2fs "
+                    "(grace remaining: %s s)", step, save_s,
+                    preempt.remaining_grace_s())
+        raise preempt.Preempted(step=step, resumed_step=resumed_step,
+                                save_s=save_s, grace_s=preempt.grace_s())
     # resume fast-forward (HF Trainer resume_from_checkpoint semantics):
     # batches the restored step counter already consumed are SKIPPED, not
     # retrained — the epoch iterators are seeded by epoch index, so
     # replaying them positions the data stream exactly where the
     # checkpoint left off; a fully-trained checkpoint yields no new steps
     to_skip = global_step
+    # NOTE: supervision arms at the FIRST step-completion beat, not
+    # here — first-step compile and the resume fast-forward can
+    # legitimately dwarf HEARTBEAT_TIMEOUT_S (worker_timeout_s bounds
+    # that phase when needed)
     try:
       for epoch in range(epochs):
+        if _preempt_requested():
+            _preempt_exit(state, None, global_step)
         if meter is not None:
             meter.reset()
         m = None
@@ -117,6 +218,8 @@ def run_training(state: TrainState,
                                    depth=prefetch, skip=to_skip)
         try:
           for batch in source:
+            if _preempt_requested():
+                _preempt_exit(state, m, global_step)
             wait_s = source.consume_wait()
             if trained_this_epoch == 0 and meter is not None:
                 # fast-forwarding consumed batches costs wall clock
@@ -129,6 +232,10 @@ def run_training(state: TrainState,
             trained_this_epoch += 1
             state, m = train_step(state, batch)
             global_step += 1
+            if heartbeat_fn is not None:
+                # step-granular liveness: the metric the supervisor
+                # watches is "this rank completed another step"
+                heartbeat_fn(global_step)
             if profiler is not None:
                 profiler.step(global_step)
             if meter is not None:
@@ -175,6 +282,10 @@ def run_training(state: TrainState,
                 with paused(meter):
                     ckpt_manager.save(global_step, save_view(state),
                                       metrics=m_host)
+            if fault_injector is not None:
+                # after the step's bookkeeping AND its scheduled save, so
+                # kind=ckpt_truncate at step k tears the step-k save
+                fault_injector.on_step(global_step)
         finally:
             # normal exhaustion already joined the workers; this reclaims
             # them on the exception path (a failing step must not leak
@@ -223,4 +334,8 @@ def run_training(state: TrainState,
 
     if ckpt_manager is not None:
         ckpt_manager.wait()
+    if heartbeat_fn is not None:
+        # supervised region ends here: post-loop export/merge work can
+        # legitimately exceed the heartbeat timeout
+        heartbeat_fn(global_step, done=True)
     return state, last_metrics

@@ -1,0 +1,146 @@
+"""Graceful preemption (SIGTERM) handling for spot/preemptible hosts.
+
+On GKE, spot and preemptible TPU pod-slices are evicted with a SIGTERM
+followed by a grace window (``PREEMPT_GRACE_S``, default 25s — the GCE
+preemption notice) before SIGKILL. The dominant production failure mode
+is therefore *not* a crash: it is a polite request to leave. This module
+turns that request into a flag the train loop checks at each step
+boundary (``train/loop.py``): on preemption the loop force-saves a
+checkpoint, waits until it is durable, and raises :class:`Preempted` —
+a status the trainer's retry loop (``rayint/trainer.py``) deliberately
+does NOT count against ``FailureConfig.max_failures`` (it is bounded by
+``max_preemptions`` instead; the hardware did nothing wrong).
+
+Slice evictions signal every host of the slice; the loop additionally
+AGREES on the exit step with a per-boundary host allgather (multi-host
+only, ``train/loop.py``) so async-dispatch skew cannot send ranks into
+forced saves at different steps — all ranks enter the same collective
+save.
+
+Stdlib-only by design: importable from the driver-side trainer without
+pulling in jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_GRACE_S = 25.0
+
+_flag = threading.Event()
+_deadline: Optional[float] = None   # monotonic end of the grace window
+_installed = False
+_prev_handler = None
+_lock = threading.Lock()
+
+
+class Preempted(Exception):
+    """The distinct "preempted" exit status of a training attempt.
+
+    Carries the attempt metadata the trainer records: the step the loop
+    stopped at, the step it had resumed from, and how long the forced
+    checkpoint save took (must fit the grace window).
+    """
+
+    def __init__(self, step: int, resumed_step: Optional[int] = None,
+                 save_s: Optional[float] = None,
+                 grace_s: Optional[float] = None):
+        self.step = step
+        self.resumed_step = resumed_step
+        self.save_s = save_s
+        self.grace_s = grace_s
+        saved = (f"checkpoint durable in {save_s:.2f}s"
+                 if save_s is not None else "no checkpoint manager — "
+                 "nothing saved")
+        super().__init__(f"preempted at step {step} ({saved})")
+
+
+def grace_s() -> float:
+    """SIGTERM→SIGKILL window advertised by the platform."""
+    return float(os.environ.get("PREEMPT_GRACE_S", DEFAULT_GRACE_S))
+
+
+def _handler(signum, frame):  # pragma: no cover - exercised via trigger()
+    request(source="SIGTERM")
+
+
+def install() -> bool:
+    """Install the SIGTERM handler (idempotent). Returns False when the
+    caller is not the main thread (flag-based ``request`` still works)."""
+    global _installed, _prev_handler
+    with _lock:
+        if _installed:
+            return True
+        try:
+            _prev_handler = signal.signal(signal.SIGTERM, _handler)
+        except ValueError:
+            logger.warning(
+                "cannot install SIGTERM handler outside the main thread; "
+                "preemption is still honored via preempt.request()")
+            return False
+        _installed = True
+    return True
+
+
+def uninstall() -> None:
+    """Restore the previous SIGTERM disposition (test teardown)."""
+    global _installed, _prev_handler
+    with _lock:
+        if not _installed:
+            return
+        try:
+            signal.signal(signal.SIGTERM,
+                          _prev_handler if _prev_handler is not None
+                          else signal.SIG_DFL)
+        except ValueError:  # pragma: no cover - non-main-thread teardown
+            pass
+        _installed = False
+        _prev_handler = None
+
+
+def request(source: str = "request") -> None:
+    """Mark this process as preempted; the loop exits at the next step
+    boundary. Safe from signal handlers and any thread."""
+    global _deadline
+    if not _flag.is_set():
+        _deadline = time.monotonic() + grace_s()
+        logger.warning(
+            "preemption requested (%s): %.0fs grace window — will "
+            "checkpoint at the next step boundary and exit 'preempted'",
+            source, grace_s())
+    _flag.set()
+
+
+def trigger() -> None:
+    """Deliver a preemption the way the platform would: a real SIGTERM
+    when the handler is installed (exercising the signal path), the flag
+    directly otherwise (non-main-thread workers)."""
+    if _installed:
+        os.kill(os.getpid(), signal.SIGTERM)
+    else:
+        request(source="trigger")
+
+
+def requested() -> bool:
+    return _flag.is_set()
+
+
+def remaining_grace_s() -> Optional[float]:
+    if _deadline is None:
+        return None
+    return max(0.0, _deadline - time.monotonic())
+
+
+def reset() -> None:
+    """Clear the flag (start of a fresh attempt — a retried attempt must
+    not inherit the previous attempt's preemption)."""
+    global _deadline
+    _flag.clear()
+    _deadline = None

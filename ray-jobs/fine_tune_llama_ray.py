@@ -274,7 +274,14 @@ def train_loop_per_worker(config: dict):
     cadence = cadence_from_config(config)
     mgr = None
     if cadence["save_enabled"]:
-        mgr = CheckpointManager(sft_dir, max_to_keep=1)
+        # recency retention, keep 2: the SFT manager exists to RESUME
+        # (the final model is exported separately below) — best-by-loss
+        # retention would garbage-collect a grace-window preemption
+        # save whose loss is not among the best, and the
+        # corrupt-checkpoint fallback (ckpt/manager.py) needs an
+        # earlier restorable step to survive an interrupted latest save
+        mgr = CheckpointManager(sft_dir, max_to_keep=2,
+                                score_attribute=None)
 
     group_by_length = bool(config.get("GROUP_BY_LENGTH", False))
     if group_by_length and packing:
@@ -337,6 +344,9 @@ def train_loop_per_worker(config: dict):
         eval_at_epoch_end=cadence["eval_at_epoch_end"],
         ckpt_every=cadence["ckpt_every"],
         ckpt_view=ckpt_view,
+        # step-granular liveness reports for the heartbeat supervisor
+        # (rayint/supervisor.py); a no-op when no sink is wired
+        heartbeat_fn=ctx.heartbeat,
         profiler=profiler_from_config(
             config, os.path.join(out_base, "profile")),
         # REPORT_TO honored (reference fine_tune_config.json:26):
@@ -464,21 +474,38 @@ if __name__ == "__main__":
         run_config=RunConfig(
             name="llama-sft-tpu",
             storage_path=config.get("OUTPUT_DIR_BASE"),
+            # fault-tolerance knobs (see README "Fault tolerance" and
+            # ray-jobs/README.md): genuine failures retry with backoff
+            # against MAX_FAILURES; spot preemptions (SIGTERM →
+            # checkpoint within PREEMPT_GRACE_S) are budgeted separately
             failure_config=FailureConfig(
-                max_failures=int(os.environ.get("MAX_FAILURES", "0"))),
+                max_failures=int(os.environ.get("MAX_FAILURES", "0")),
+                max_preemptions=int(
+                    os.environ.get("MAX_PREEMPTIONS", "8"))),
             # hang detection (rayint/trainer.py): unset = wait forever
             worker_timeout_s=(float(os.environ["WORKER_TIMEOUT_S"])
                               if "WORKER_TIMEOUT_S" in os.environ
-                              else None)),
+                              else None),
+            # step-granular supervision (rayint/supervisor.py): kill an
+            # attempt — naming the stalled rank — when a worker makes no
+            # step progress for this long; unset = no heartbeat watch
+            heartbeat_timeout_s=(float(os.environ["HEARTBEAT_TIMEOUT_S"])
+                                 if "HEARTBEAT_TIMEOUT_S" in os.environ
+                                 else None)),
     )
     result = trainer.fit()
     if result.error:
-        logger.error("training failed: %s", result.error)
+        logger.error("training %s after %d attempt(s) "
+                     "(%d preemption(s)): %s", result.status,
+                     result.attempts, result.preemptions, result.error)
         sys.exit(1)
-    logger.info("final metrics: %s", result.metrics)
+    logger.info("final metrics: %s (attempts=%d preemptions=%d)",
+                result.metrics, result.attempts, result.preemptions)
     # one machine-readable line on stdout (logging goes to stderr) so
     # drivers/scripts (scripts/record_baselines.sh) can collect the
     # job's meter numbers the same way they collect bench.py records
-    print(json.dumps({"metric": "flagship_final", **{
-        k: v for k, v in (result.metrics or {}).items()
-        if isinstance(v, (int, float))}}), flush=True)
+    print(json.dumps({"metric": "flagship_final",
+                      "attempts": result.attempts,
+                      "preemptions": result.preemptions, **{
+                          k: v for k, v in (result.metrics or {}).items()
+                          if isinstance(v, (int, float))}}), flush=True)

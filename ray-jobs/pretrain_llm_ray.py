@@ -116,8 +116,12 @@ def train_loop_per_worker(config: dict):
     run_dir = os.path.join(
         config.get("storage_path", "/mnt/pvc/ray_llm_training_runs"),
         config.get("run_name", "basic_lm"))
-    mgr = CheckpointManager(run_dir, max_to_keep=1,
-                            score_attribute="loss", score_mode="min")
+    # recency retention, keep 2 (NOT the reference's keep-1-best): the
+    # training manager exists to RESUME — best-by-loss retention would
+    # garbage-collect a grace-window preemption save whose loss is not
+    # among the best, and the corrupt-checkpoint fallback needs an
+    # earlier restorable step to survive an interrupted latest save
+    mgr = CheckpointManager(run_dir, max_to_keep=2, score_attribute=None)
     if ctx.is_host0():
         # tokenizer beside the checkpoints: the run dir alone is enough
         # to decode/resume (reference saves the tokenizer with the
@@ -141,6 +145,9 @@ def train_loop_per_worker(config: dict):
         log_every=int(config.get("log_every", 20)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),
+        # step-granular liveness reports for the heartbeat supervisor
+        # (rayint/supervisor.py); a no-op when no sink is wired
+        heartbeat_fn=ctx.heartbeat,
         profiler=profiler_from_config(
             config, os.path.join(config.get("storage_path", "/tmp"),
                                  "profile")),
@@ -186,15 +193,27 @@ if __name__ == "__main__":
         run_config=RunConfig(
             name="basic-lm-pretrain",
             storage_path=train_loop_config["storage_path"],
+            # fault-tolerance knobs (README "Fault tolerance",
+            # ray-jobs/README.md): failures vs preemptions are budgeted
+            # separately — a spot eviction must not burn a retry slot
             failure_config=FailureConfig(
-                max_failures=int(os.environ.get("MAX_FAILURES", "0"))),
+                max_failures=int(os.environ.get("MAX_FAILURES", "0")),
+                max_preemptions=int(
+                    os.environ.get("MAX_PREEMPTIONS", "8"))),
             # hang detection (rayint/trainer.py): unset = wait forever
             worker_timeout_s=(float(os.environ["WORKER_TIMEOUT_S"])
                               if "WORKER_TIMEOUT_S" in os.environ
-                              else None)),
+                              else None),
+            # step-granular supervision (rayint/supervisor.py)
+            heartbeat_timeout_s=(float(os.environ["HEARTBEAT_TIMEOUT_S"])
+                                 if "HEARTBEAT_TIMEOUT_S" in os.environ
+                                 else None)),
     )
     result = trainer.fit()
     if result.error:
-        logger.error("training failed: %s", result.error)
+        logger.error("training %s after %d attempt(s) "
+                     "(%d preemption(s)): %s", result.status,
+                     result.attempts, result.preemptions, result.error)
         sys.exit(1)
-    logger.info("final metrics: %s", result.metrics)
+    logger.info("final metrics: %s (attempts=%d preemptions=%d)",
+                result.metrics, result.attempts, result.preemptions)

@@ -34,6 +34,11 @@ run moe            env BENCH_MODE=moe python bench.py
 run qwen2-lora     env BENCH_MODE=qwen2-lora python bench.py
 run decode         env BENCH_MODE=decode python bench.py
 
+# fault-tolerance drill: time-to-recover (injected kill -> first
+# post-resume step) + checkpoint-save latency under SIGTERM (must fit
+# the preemption grace window)
+run recovery       env BENCH_MODE=recovery python bench.py
+
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
 # defaults on the seq4k shape where the kernel dominates (up to 8 extra
 # bench runs; the default q=256/kv=1024 cell IS the `seq4k` record

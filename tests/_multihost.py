@@ -25,7 +25,15 @@ spec = importlib.util.spec_from_file_location(
 mod = importlib.util.module_from_spec(spec)
 spec.loader.exec_module(mod)
 config = json.loads(os.environ["MULTIHOST_SMOKE_CONFIG"])
-metrics = mod.train_loop_per_worker(config)
+try:
+    metrics = mod.train_loop_per_worker(config)
+except BaseException as e:
+    # the distinct graceful-preemption exit (train/preempt.py) — the
+    # fault-injection drills assert every rank takes it together
+    if type(e).__name__ == "Preempted":
+        print("WORKER_PREEMPTED", jax.process_index(), flush=True)
+        sys.exit(0)
+    raise
 assert metrics and "loss" in metrics, metrics
 print("WORKER_OK", jax.process_index(), flush=True)
 """
@@ -40,14 +48,19 @@ def free_port() -> int:
 def run_entry_multiprocess(script: str, config: dict, *,
                            num_processes: int = 2,
                            devices_per_process: int = 4,
-                           timeout: float = 900) -> list:
+                           timeout: float = 900,
+                           extra_env: dict = None,
+                           expect: str = "ok") -> list:
     """Run ray-jobs/<script>'s worker fn across real processes; returns
     the per-rank stdout. Raises AssertionError with the failing rank's
-    tail on any non-zero exit."""
+    tail on any non-zero exit. ``extra_env`` reaches every worker (e.g.
+    FAULT_SPEC for the fault-injection drills); ``expect`` is "ok" or
+    "preempted" (every rank must exit with that status)."""
     port = free_port()
     procs = []
     for rank in range(num_processes):
         env = dict(os.environ)
+        env.update(extra_env or {})
         env.update({
             "JAX_PLATFORMS": "cpu",
             "HF_HUB_OFFLINE": "1",   # fail fast to offline fallbacks
@@ -88,8 +101,10 @@ def run_entry_multiprocess(script: str, config: dict, *,
     assert not hung, (
         f"worker(s) {hung} hung past {timeout}s; outputs:\n" +
         "\n---\n".join(o[-2000:] for o in outs))
+    token = {"ok": "WORKER_OK", "preempted": "WORKER_PREEMPTED"}[expect]
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
-        assert f"WORKER_OK {rank}" in out
+        assert f"{token} {rank}" in out, (
+            f"worker {rank} did not exit '{expect}':\n{out[-2000:]}")
     return outs

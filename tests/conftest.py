@@ -16,6 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the trainer's genuine-failure retries back off exponentially
+# (rayint/trainer.py); the suite's deliberate-failure tests must not
+# each pay real sleeps
+os.environ.setdefault("RETRY_BACKOFF_S", "0")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,435 @@
+"""The fault-tolerance layer, driven end-to-end by deterministic fault
+injection (FAULT_SPEC, gke_ray_train_tpu/testing/faults.py).
+
+Acceptance drills (ISSUE 3): an injected ``kill`` at step k resumes
+from the latest checkpoint with an identical consumed-batch stream
+(test_resume_skip's equivalence machinery); a ``sigterm`` checkpoints
+within the grace window and exits 'preempted' WITHOUT consuming the
+``max_failures`` budget; a truncated latest checkpoint restores from
+the prior step with the corrupt step quarantined; a ``hang`` triggers
+the heartbeat timeout naming the stalled rank. Plus the retry-loop
+policy: non-retryable errors fail fast, genuine failures back off
+exponentially with jitter.
+"""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gke_ray_train_tpu.ckpt import CheckpointManager
+from gke_ray_train_tpu.models import tiny
+from gke_ray_train_tpu.rayint import (
+    FailureConfig, JaxTrainer, RunConfig, get_context)
+from gke_ray_train_tpu.testing.faults import (
+    FaultInjector, FaultSpec, InjectedKill, parse_fault_spec, reset_fired)
+from gke_ray_train_tpu.train import (
+    make_optimizer, make_train_state, make_train_step, preempt)
+from gke_ray_train_tpu.train.loop import run_training
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Fault state is process-global by design (fire-once across retry
+    attempts); tests must not leak it into each other."""
+    monkeypatch.delenv("FAULT_SPEC", raising=False)
+    reset_fired()
+    preempt.reset()
+    yield
+    reset_fired()
+    preempt.reset()
+    preempt.uninstall()
+
+
+def _setup():
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step_fn = make_train_step(cfg, opt, donate=False)
+
+    def batches(epoch):
+        for i in range(4):
+            k = jax.random.key(epoch * 10 + i)
+            yield {
+                "inputs": jax.random.randint(k, (2, 8), 0, 64),
+                "targets": jax.random.randint(k, (2, 8), 0, 64),
+                "weights": jnp.ones((2, 8), jnp.float32),
+            }
+
+    return state, step_fn, batches
+
+
+def _worker(ckpt_dir, *, ckpt_every=None, epochs=1, record=None,
+            heartbeat=False, max_to_keep=4):
+    """A JaxTrainer worker fn running the real loop on the tiny model.
+    ``record`` collects {trained_step: batch_fingerprint} — later
+    attempts overwrite, so equality with an uninterrupted run proves
+    the resumed stream realigns instead of skewing or retraining."""
+    def worker(config):
+        state, step_fn, batches = _setup()
+        mgr = CheckpointManager(ckpt_dir, max_to_keep=max_to_keep,
+                                async_save=False, score_attribute=None)
+
+        def recording_step(st, batch):
+            if record is not None:
+                step = int(jax.device_get(st.step)) + 1
+                record[step] = int(jax.device_get(batch["inputs"]).sum())
+            return step_fn(st, batch)
+
+        try:
+            final, metrics = run_training(
+                state, recording_step, batches, epochs=epochs,
+                ckpt_manager=mgr, ckpt_every=ckpt_every,
+                heartbeat_fn=(get_context().heartbeat if heartbeat
+                              else None))
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **metrics}
+    return worker
+
+
+# ---- FAULT_SPEC grammar ---------------------------------------------
+
+def test_fault_spec_grammar():
+    specs = parse_fault_spec(
+        "rank=1:kind=kill:step=5;rank=*:kind=hang:step=3:seconds=7.5")
+    assert specs[0] == FaultSpec(kind="kill", step=5, rank="1")
+    assert specs[1].seconds == 7.5 and specs[1].rank == "*"
+    assert specs[0].matches(1, 5)
+    assert not specs[0].matches(0, 5) and not specs[0].matches(1, 4)
+    assert specs[1].matches(2, 3)  # rank=* matches every rank
+
+
+@pytest.mark.parametrize("bad", [
+    "kind=explode:step=1",            # unknown kind
+    "kind=kill",                      # missing step
+    "step=3",                         # missing kind
+    "rank=1:kind=kill:step=5:foo=1",  # unknown field
+    "kill@5",                         # not key=value
+])
+def test_fault_spec_rejects_garbage(bad):
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_fault_fires_once_per_process():
+    inj = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                        rank=0)
+    with pytest.raises(InjectedKill):
+        inj.on_step(2)
+    inj.on_step(2)  # already fired: no re-fire
+    # a fresh injector from the same spec (what a retried attempt
+    # builds) must ALSO see the fault as spent
+    inj2 = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                         rank=0)
+    inj2.on_step(2)
+
+
+def test_fault_fires_once_across_processes_via_marker(tmp_path):
+    """On real Ray every retry is a FRESH worker process that re-reaches
+    the fault step after resume; the marker file beside the checkpoints
+    must keep the fault spent (reset_fired() simulates the new
+    process's empty in-memory registry)."""
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), score_attribute=None,
+                            async_save=False)
+    spec = parse_fault_spec("rank=0:kind=kill:step=2")
+    inj = FaultInjector(spec, rank=0, ckpt_manager=mgr)
+    with pytest.raises(InjectedKill):
+        inj.on_step(2)
+    reset_fired()  # "new process"
+    inj2 = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                         rank=0, ckpt_manager=mgr)
+    inj2.on_step(2)  # marker file says: already fired
+    mgr.close()
+
+
+# ---- kill → retry-with-resume ---------------------------------------
+
+def test_kill_resumes_with_identical_batch_stream(tmp_path, monkeypatch):
+    ref_record = {}
+    ref = JaxTrainer(
+        _worker(str(tmp_path / "ref"), ckpt_every=2, epochs=2,
+                record=ref_record),
+        use_ray=False).fit()
+    assert ref.error is None and ref.metrics["final_step"] == 8
+
+    faulted_record = {}
+    monkeypatch.setenv("FAULT_SPEC", "rank=0:kind=kill:step=5")
+    res = JaxTrainer(
+        _worker(str(tmp_path / "faulted"), ckpt_every=2, epochs=2,
+                record=faulted_record),
+        use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None
+    assert res.attempts == 2 and res.preemptions == 0
+    assert res.attempt_log[0]["status"] == "failed"
+    assert "injected kill at step 5" in res.attempt_log[0]["error"]
+    # killed at 5, last checkpoint at 4 → the retry resumed from 4
+    assert res.attempt_log[1]["resumed_step"] == 4
+    assert res.metrics["final_step"] == 8
+    # the consumed-batch stream (step → batch fingerprint) is identical
+    # to the uninterrupted run: resume skipped exactly the consumed
+    # batches and retrained exactly the lost ones
+    assert faulted_record == ref_record
+    # same state at 4 + same batches after → identical final loss
+    assert res.metrics["loss"] == ref.metrics["loss"]
+
+
+def test_kill_with_no_budget_reports_failure(tmp_path, monkeypatch):
+    monkeypatch.setenv("FAULT_SPEC", "rank=0:kind=kill:step=2")
+    res = JaxTrainer(
+        _worker(str(tmp_path / "run"), epochs=1),
+        use_ray=False).fit()   # max_failures defaults to 0
+    assert res.status == "failed" and res.attempts == 1
+    assert "injected kill at step 2" in res.error
+
+
+# ---- sigterm → graceful preemption ----------------------------------
+
+def test_sigterm_checkpoints_and_preempts_without_failure_budget(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("FAULT_SPEC", "rank=0:kind=sigterm:step=3")
+    res = JaxTrainer(
+        _worker(str(tmp_path / "run"), epochs=1),
+        use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=0, max_preemptions=2))).fit()
+    # max_failures=0: had the preemption been booked as a failure, the
+    # run would have died — instead it resumed and completed
+    assert res.error is None
+    assert res.preemptions == 1 and res.attempts == 2
+    first = res.attempt_log[0]
+    assert first["status"] == "preempted" and first["step"] == 3
+    assert first["ckpt_save_s"] is not None and first["ckpt_save_s"] >= 0
+    # the forced save landed at the preemption step and the retry
+    # resumed from it (no ckpt_every here — ONLY the grace-window save)
+    assert res.attempt_log[1]["resumed_step"] == 3
+    assert res.metrics["final_step"] == 4
+
+
+def test_sigterm_budget_exhausted_reports_preempted_status(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("FAULT_SPEC", "rank=0:kind=sigterm:step=2")
+    res = JaxTrainer(
+        _worker(str(tmp_path / "run"), epochs=1),
+        use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=3, max_preemptions=0))).fit()
+    # the untouched max_failures=3 budget proves the classification
+    assert res.status == "preempted"
+    assert res.attempts == 1 and res.preemptions == 1
+    assert "preempted at step 2" in res.error
+    assert res.metrics == {}
+
+
+# ---- ckpt_truncate → corrupt-checkpoint fallback --------------------
+
+def test_ckpt_truncate_falls_back_to_prior_step_and_quarantines(
+        tmp_path, monkeypatch):
+    d = str(tmp_path / "run")
+    monkeypatch.setenv("FAULT_SPEC", "rank=0:kind=ckpt_truncate:step=4")
+    res = JaxTrainer(
+        _worker(d, ckpt_every=2, epochs=1), use_ray=False).fit()
+    assert res.error is None and res.metrics["final_step"] == 4
+
+    # the latest step (4) is now a torn tail; a resume must fall back
+    # to step 2 and quarantine 4, not crash every subsequent attempt
+    monkeypatch.delenv("FAULT_SPEC")
+    record = {}
+    res2 = JaxTrainer(
+        _worker(d, ckpt_every=2, epochs=1, record=record),
+        use_ray=False).fit()
+    assert res2.error is None
+    assert res2.attempt_log[0]["resumed_step"] == 2
+    assert res2.metrics["final_step"] == 4
+    assert sorted(record) == [3, 4]  # retrained exactly steps 3 and 4
+    assert os.path.isdir(os.path.join(d, "4.corrupt"))
+
+
+def test_restore_if_available_falls_back_and_quarantines(tmp_path):
+    d = str(tmp_path / "ckpt")
+    state = {"w": jnp.arange(512, dtype=jnp.float32)}
+    mgr = CheckpointManager(d, max_to_keep=3, score_attribute=None,
+                            async_save=False)
+    mgr.save(2, state)
+    mgr.save(4, {"w": state["w"] * 2})
+    mgr.close()
+    FaultInjector([FaultSpec(kind="ckpt_truncate", step=4)],
+                  ckpt_manager=CheckpointManager(
+                      d, max_to_keep=3, score_attribute=None,
+                      async_save=False))._truncate_latest(4)
+
+    mgr2 = CheckpointManager(d, max_to_keep=3, score_attribute=None,
+                             async_save=False)
+    out, step = mgr2.restore_if_available(state)
+    assert step == 2
+    assert float(out["w"].sum()) == float(state["w"].sum())
+    assert mgr2.latest_step() == 2
+    assert os.path.isdir(os.path.join(d, "4.corrupt"))
+    mgr2.close()
+
+
+def test_restore_if_available_reraises_when_every_step_fails(tmp_path):
+    """A restore error on EVERY step is a template/layout mismatch, not
+    a corrupt tail — nothing may be quarantined (destroying the only
+    resume point on a caller bug would be worse than the crash)."""
+    d = str(tmp_path / "ckpt")
+    state = {"w": jnp.arange(512, dtype=jnp.float32)}
+    mgr = CheckpointManager(d, score_attribute=None, async_save=False)
+    mgr.save(2, state)
+    mgr.close()
+    wrong_template = {"w": jnp.zeros((512,), jnp.float32),
+                      "extra": jnp.zeros((4,), jnp.float32)}
+    mgr2 = CheckpointManager(d, score_attribute=None, async_save=False)
+    with pytest.raises(Exception):
+        mgr2.restore_if_available(wrong_template)
+    assert os.path.isdir(os.path.join(d, "2"))        # untouched
+    assert not os.path.exists(os.path.join(d, "2.corrupt"))
+    mgr2.close()
+
+
+# ---- hang → heartbeat supervision -----------------------------------
+
+def test_hang_triggers_heartbeat_timeout_naming_rank(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("FAULT_SPEC",
+                       "rank=0:kind=hang:step=2:seconds=30")
+    t0 = time.monotonic()
+    res = JaxTrainer(
+        _worker(str(tmp_path / "run"), epochs=1, heartbeat=True),
+        use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0),
+            heartbeat_timeout_s=1.5)).fit()
+    # detected at step granularity — NOT by waiting out the 30s hang
+    assert time.monotonic() - t0 < 20
+    assert res.status == "failed" and res.attempts == 1
+    assert "heartbeat timeout" in res.error
+    assert "rank 0" in res.error
+    assert "no step progress for 1.5s" in res.error
+
+
+def test_heartbeat_board_same_step_is_not_progress(monkeypatch):
+    import gke_ray_train_tpu.rayint.supervisor as sup
+    clock = {"t": 100.0}
+    monkeypatch.setattr(sup.time, "monotonic", lambda: clock["t"])
+    board = sup.HeartbeatBoard()
+    board.beat(0, 1)
+    clock["t"] = 105.0
+    board.beat(0, 1)   # re-reporting the same step is not progress
+    assert board.stalled(4.0) == [(0, 1, 5.0)]
+    board.beat(0, 2)   # a step advance refreshes the clock
+    assert board.stalled(4.0) == []
+    clock["t"] = 111.0
+    assert board.stalled(4.0) == [(0, 2, 6.0)]
+    board.beat(0, -1, done=True)
+    assert board.stalled(4.0) == []  # done ranks are never stalled
+
+
+# ---- retry-loop policy ----------------------------------------------
+
+def test_nonretryable_config_error_fails_fast():
+    calls = {"n": 0}
+
+    def broken(config):
+        calls["n"] += 1
+        raise KeyError("MODEL_ID")
+
+    res = JaxTrainer(
+        broken, use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=3))).fit()
+    assert calls["n"] == 1, "a deterministic error must not be retried"
+    assert res.attempts == 1 and res.status == "failed"
+    assert "MODEL_ID" in res.error
+    assert res.attempt_log[0].get("nonretryable") is True
+
+
+def test_checkpoint_restore_error_is_retryable_despite_valueerror_cause():
+    """A collective restore failure wraps its (often ValueError) cause
+    in CheckpointRestoreError — the retry classifier must treat the
+    wrapper as retryable instead of failing fast on the cause."""
+    from gke_ray_train_tpu.ckpt.manager import CheckpointRestoreError
+
+    calls = {"n": 0}
+
+    def flaky_restore(config):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            try:
+                raise ValueError("torn tensorstore read")
+            except ValueError as v:
+                raise CheckpointRestoreError(
+                    "step 5 failed to restore on another host") from v
+        return {"ok": 1}
+
+    res = JaxTrainer(
+        flaky_restore, use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None and calls["n"] == 2
+
+
+def test_retry_backoff_grows_exponentially_with_jitter(monkeypatch):
+    import gke_ray_train_tpu.rayint.trainer as tm
+    delays = []
+    monkeypatch.setattr(tm.time, "sleep", lambda s: delays.append(s))
+    calls = {"n": 0}
+
+    def flaky(config):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return {"ok": 1}
+
+    res = JaxTrainer(
+        flaky, use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=2),
+            retry_backoff_s=1.0)).fit()
+    assert res.error is None and res.attempts == 3
+    assert len(delays) == 2
+    assert 0.5 <= delays[0] <= 1.5     # 1.0 * 2^0 * jitter [0.5, 1.5)
+    assert 1.0 <= delays[1] <= 3.0     # 1.0 * 2^1 * jitter
+
+
+def test_result_attempt_metadata_on_clean_run():
+    res = JaxTrainer(lambda c: {"x": 1}, use_ray=False).fit()
+    assert res.status == "ok"
+    assert res.attempts == 1 and res.preemptions == 0
+    assert res.attempt_log == [{"status": "ok", "resumed_step": None}]
+
+
+# ---- multi-process drill (tests/_multihost.py path) ------------------
+
+@pytest.mark.slow
+def test_multihost_sigterm_drill(tmp_path):
+    """rank=* sigterm on a real 2-process SPMD run: every rank preempts
+    at the same step boundary, the forced save is collective, and every
+    worker exits with the distinct 'preempted' status."""
+    from tests._multihost import run_entry_multiprocess
+
+    config = {
+        "d_model": 64, "n_layers": 2, "n_heads": 4, "d_ff": 128,
+        "dataset_seq_len": 64, "model_max_seq_len": 128,
+        "batch_size_per_device": 1,
+        "lr": 3e-4, "epochs": 1, "test_run": True, "max_samples": 64,
+        "log_every": 1, "dtype": "float32",
+        "data_dir": str(tmp_path / "data"),
+        "storage_path": str(tmp_path / "runs"),
+        "run_name": "drill",
+        "MESH_DATA": 2, "MESH_FSDP": -1,
+    }
+    run_entry_multiprocess(
+        "pretrain_llm_ray.py", config,
+        extra_env={"FAULT_SPEC": "rank=*:kind=sigterm:step=2"},
+        expect="preempted")
+    # the grace-window checkpoint landed collectively at the fault step
+    ckpt_root = tmp_path / "runs" / "drill"
+    steps = [d for d in os.listdir(ckpt_root) if d.isdigit()]
+    assert steps == ["2"], steps

@@ -20,8 +20,19 @@ from gke_ray_train_tpu.rayint.trainer import (
 
 
 class _Future:
-    def __init__(self, value):
-        self.value = value
+    """Executes eagerly (at .remote time, like the old fake) but holds
+    exceptions until .value — real Ray surfaces task errors at ray.get,
+    and the trainer's per-rank error attribution lives there."""
+
+    def __init__(self, value=None, error=None):
+        self._v = value
+        self._err = error
+
+    @property
+    def value(self):
+        if self._err is not None:
+            raise self._err
+        return self._v
 
 
 class _ActorMethod:
@@ -29,7 +40,10 @@ class _ActorMethod:
         self._bound = bound
 
     def remote(self, *a, **k):
-        return _Future(self._bound(*a, **k))
+        try:
+            return _Future(self._bound(*a, **k))
+        except Exception as e:  # noqa: BLE001 - delivered at ray.get
+            return _Future(error=e)
 
 
 class _ActorHandle:
@@ -72,7 +86,10 @@ def make_fake_ray(record):
                         @staticmethod
                         def remote():
                             record["actor_opts"].append(opts)
-                            return _ActorHandle(cls, opts)
+                            handle = _ActorHandle(cls, opts)
+                            record.setdefault("actors", []).append(
+                                handle._inst)
+                            return handle
                     return Factory
             return Remote
         if dargs and callable(dargs[0]):
@@ -91,10 +108,18 @@ def make_fake_ray(record):
                          else f.value)
 
     def wait(futures, num_returns=None, timeout=None):
-        # the sync fake cannot truly hang; futures whose value is the
-        # sentinel "HANG" model a worker stuck in a dead collective
-        done = [f for f in futures if f.value != "HANG"]
-        pending = [f for f in futures if f.value == "HANG"]
+        # the sync fake cannot truly hang; workers returning the
+        # sentinel "HANG" model one stuck in a dead collective (the
+        # trainer's worker wrapper ships it inside the result payload).
+        # Errored futures count as done (real ray.wait returns them as
+        # ready; the error is delivered at ray.get)
+        def hanging(f):
+            v = f._v
+            return f._err is None and (
+                v == "HANG" or (isinstance(v, dict)
+                                and v.get("metrics") == "HANG"))
+        done = [f for f in futures if not hanging(f)]
+        pending = [f for f in futures if hanging(f)]
         return done, pending
 
     ray.wait = wait
@@ -110,7 +135,7 @@ def make_fake_ray(record):
 
 @pytest.fixture
 def fake_ray(monkeypatch):
-    record = {"actor_opts": [], "placement_groups": [],
+    record = {"actor_opts": [], "placement_groups": [], "actors": [],
               "sched_bundles": [], "removed_pgs": [], "killed": []}
     ray, mods = make_fake_ray(record)
     monkeypatch.setattr(trainer_mod, "ray", ray)
@@ -300,3 +325,149 @@ def test_fit_ray_exhausted_retries_reports_error(fake_ray):
         use_ray=True)
     result = trainer.fit()
     assert result.error is not None and "chip on fire" in result.error
+
+
+def test_worker_failure_names_rank_and_node(fake_ray):
+    """A worker exception surfaced by ray.get must say WHICH rank on
+    WHICH node raised — "a worker died" is undebuggable on a slice."""
+    def rank1_explodes(config):
+        import os
+        if os.environ["PROCESS_ID"] == "1":
+            raise RuntimeError("boom")
+        return {"ok": 0}
+
+    trainer = JaxTrainer(
+        rank1_explodes,
+        scaling_config=ScalingConfig(num_workers=2),
+        use_ray=True)
+    result = trainer.fit()
+    assert result.error is not None
+    assert "worker rank 1" in result.error
+    assert "10.0.0.1" in result.error
+    assert "boom" in result.error
+
+
+def test_preemption_through_ray_not_counted_as_failure(fake_ray):
+    """A Preempted raised by a Ray worker must be classified by the
+    retry loop as a preemption (own budget), not a failure —
+    max_failures=0 here proves the failure budget stays untouched."""
+    from gke_ray_train_tpu.train.preempt import Preempted
+
+    calls = {"n": 0}
+
+    def preempted_once(config):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise Preempted(step=3, resumed_step=None, save_s=0.1)
+        return {"ok": 1}
+
+    trainer = JaxTrainer(
+        preempted_once,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=0, max_preemptions=2)),
+        use_ray=True)
+    result = trainer.fit()
+    assert result.error is None and result.metrics == {"ok": 1}
+    assert result.preemptions == 1 and result.attempts == 2
+    assert result.attempt_log[0]["status"] == "preempted"
+    assert result.attempt_log[0]["step"] == 3
+    assert result.attempt_log[1]["status"] == "ok"
+
+
+def test_heartbeat_stall_kills_attempt_naming_rank(fake_ray, monkeypatch):
+    """Driver-side supervision: when the supervisor reports a stalled
+    rank, the attempt is killed and the error names that rank (the fake
+    cannot truly wedge a worker, so the supervisor's verdict is
+    pinned)."""
+    from gke_ray_train_tpu.rayint import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.Supervisor, "stalled",
+                        lambda self, timeout_s: [(1, 5, 9.9)])
+    trainer = JaxTrainer(
+        lambda config: "HANG",
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0),
+            heartbeat_timeout_s=0.05),
+        use_ray=True)
+    result = trainer.fit()
+    assert result.error is not None
+    assert "heartbeat timeout" in result.error
+    assert "rank 1" in result.error and "last step 5" in result.error
+    assert len(fake_ray["killed"]) == 2  # whole attempt torn down
+    assert fake_ray["removed_pgs"] == fake_ray["placement_groups"]
+
+
+def test_crashed_rank_root_cause_beats_victim_stall(fake_ray, monkeypatch):
+    """When one rank crashes and its collective partners wedge, the
+    error must be the crash (the root cause), not the victims' stall."""
+    from gke_ray_train_tpu.rayint import supervisor as sup_mod
+
+    monkeypatch.setattr(sup_mod.Supervisor, "stalled",
+                        lambda self, timeout_s: [(0, 3, 9.9)])
+
+    def rank1_crashes_rank0_wedges(config):
+        import os
+        if os.environ["PROCESS_ID"] == "1":
+            raise RuntimeError("real root cause")
+        return "HANG"
+
+    trainer = JaxTrainer(
+        rank1_crashes_rank0_wedges,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0),
+            heartbeat_timeout_s=0.05),
+        use_ray=True)
+    result = trainer.fit()
+    assert "worker rank 1" in result.error
+    assert "real root cause" in result.error
+    assert "heartbeat timeout" not in result.error
+
+
+def test_startup_crash_surfaces_under_heartbeat_only_supervision(fake_ray):
+    """With only heartbeat_timeout_s set, a rank crashing BEFORE any
+    step (supervision never arms — no beats) must surface its error
+    promptly instead of the wait loop polling forever."""
+    def rank1_crashes_rank0_wedges(config):
+        import os
+        if os.environ["PROCESS_ID"] == "1":
+            raise RuntimeError("boom at startup")
+        return "HANG"
+
+    trainer = JaxTrainer(
+        rank1_crashes_rank0_wedges,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=0),
+            heartbeat_timeout_s=60.0),
+        use_ray=True)
+    result = trainer.fit()  # would loop forever without the fix
+    assert "worker rank 1" in result.error
+    assert "boom at startup" in result.error
+
+
+def test_worker_heartbeats_flow_to_supervisor(fake_ray):
+    """Worker-side plumbing: ctx.heartbeat reaches the supervisor actor
+    with the right rank, and completion marks the rank done."""
+    from gke_ray_train_tpu.rayint.supervisor import Supervisor
+
+    def beats_then_returns(config):
+        from gke_ray_train_tpu.rayint import get_context
+        get_context().heartbeat(7)
+        return {}
+
+    trainer = JaxTrainer(
+        beats_then_returns,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(heartbeat_timeout_s=60.0),
+        use_ray=True)
+    result = trainer.fit()
+    assert result.error is None
+    sups = [a for a in fake_ray["actors"] if isinstance(a, Supervisor)]
+    assert len(sups) == 1
+    snap = sups[0].snapshot()
+    assert snap[0]["step"] == 7 and snap[1]["step"] == 7
+    assert snap[0]["done"] and snap[1]["done"]
+    assert sups[0].stalled(0.0) == []  # done ranks are never stalled
