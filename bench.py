@@ -25,7 +25,18 @@ bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec),
 ``input-bound`` (async input pipeline A/B: real packing path behind a
 deliberately slow host stall, prefetch on vs off on one JSON line),
 ``recovery`` (fault drill: time-to-recover from an injected kill +
-checkpoint-save latency under SIGTERM, testing/faults.py).
+checkpoint-save latency under SIGTERM, testing/faults.py; the record
+separates recompile time from restore+fast-forward time),
+``compile`` (compile-once layer A/B, perf/: cold build vs warm
+persistent-cache build vs deserialized AOT executable, plus the
+compile-level StepCostReport — meaningful on ANY backend, including
+the CPU mesh).
+
+Dead-accelerator behavior: when the backend probe fails, the bench
+re-execs itself on the 8-fake-device CPU mesh and still emits a VALID
+metric record tagged ``"backend": "cpu-fallback"`` (compile-level cost
+numbers + CPU proxy tok/s) instead of an error JSON — the driver
+trajectory stays populated through accelerator outages.
 
 vs_baseline: ratio against this framework's own first-light number
 (bench_baseline.json) — the reference publishes no numbers (BASELINE.md).
@@ -101,8 +112,17 @@ def _emit(metric, value, unit, extra, compare_baseline=True):
         "value": round(value, 1),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else 1.0,
+        # provenance: a CPU-fallback record must never masquerade as an
+        # accelerator number (the r4-r5 BENCH gap was error JSONs; the
+        # fix is valid-but-tagged records)
+        "backend": ("cpu-fallback"
+                    if os.environ.get("BENCH_CPU_FALLBACK") == "1"
+                    else devices[0].platform),
         **extra,
     }
+    if os.environ.get("BENCH_FALLBACK_REASON"):
+        result["fallback_reason"] = \
+            os.environ["BENCH_FALLBACK_REASON"][:200]
     print(json.dumps(result))
     on_tpu = devices[0].platform != "cpu"
     if compare_baseline and baseline is None and on_tpu and \
@@ -145,11 +165,22 @@ def bench_train():
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
-    step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
+    # donate_batch=False: the timing loop feeds the SAME placed batch
+    # every step; a donated buffer must not be reused
+    step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule,
+                           donate_batch=False)
 
     batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
                            batch_shardings(mesh))
-    dt_get, dt_block, loss = _run_timed_train(step, state, batch, steps)
+    # AOT lower+compile once: the SAME executable is timed below AND
+    # feeds the compile-level cost report (perf/costs.py) — so every
+    # bench record carries the hardware-independent numbers too
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+    t0 = time.perf_counter()
+    compiled = step.lower(state, batch).compile()
+    compile_s = time.perf_counter() - t0
+    cost = step_cost_report(compiled, tokens_per_step=B * S)
+    dt_get, dt_block, loss = _run_timed_train(compiled, state, batch, steps)
     tokens = B * S * steps
     tps_chip = tokens / dt_get / n_dev
     mfu = (tokens / dt_get) * train_flops_per_token(cfg, S) / (
@@ -160,6 +191,8 @@ def bench_train():
         f"{devices[0].device_kind} x{n_dev})",
         tps_chip, "tokens/sec/chip",
         {"mfu": round(mfu, 4), "loss": round(loss, 4),
+         "compile_s": round(compile_s, 3),
+         "cost_report": cost.summary(),
          "timing": {"device_get_s": round(dt_get, 4),
                     "block_until_ready_s": round(dt_block, 4)}})
 
@@ -212,7 +245,9 @@ def _bench_qlora_family(cfg, label, *, B, S, steps, lora_r=64):
     opt_state = jax.jit(opt.init)(lora)
     state = TrainState(params=params, lora=lora, opt_state=opt_state,
                        step=jnp.zeros((), jnp.int32))
-    step = make_train_step(cfg, opt, lora_cfg=lcfg, schedule=schedule)
+    # the timing loop re-feeds one placed batch -> no batch donation
+    step = make_train_step(cfg, opt, lora_cfg=lcfg, schedule=schedule,
+                           donate_batch=False)
 
     dt_get, dt_block, loss = _run_timed_train(
         step, state, _rand_batch(B, S, cfg.vocab_size), steps)
@@ -318,7 +353,9 @@ def bench_gemma2_4k():
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)
     state = make_train_state(cfg, opt, jax.random.key(0))
-    step = make_train_step(cfg, opt, schedule=schedule)
+    # the timing loop re-feeds one placed batch -> no batch donation
+    step = make_train_step(cfg, opt, schedule=schedule,
+                           donate_batch=False)
 
     # packed rows: 4 documents per row, positions restart per segment
     seg_len = S // 4
@@ -373,7 +410,9 @@ def bench_seq4k():
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)
     state = make_train_state(cfg, opt, jax.random.key(0))
-    step = make_train_step(cfg, opt, schedule=schedule)
+    # the timing loop re-feeds one placed batch -> no batch donation
+    step = make_train_step(cfg, opt, schedule=schedule,
+                           donate_batch=False)
 
     # packed rows: 4 documents per row, positions restart per segment
     seg_len = S // 4
@@ -432,7 +471,9 @@ def bench_moe():
     schedule = warmup_cosine_schedule(3e-4, 1000)
     opt = make_optimizer(schedule)
     state = make_train_state(cfg, opt, jax.random.key(0))
-    step = make_train_step(cfg, opt, schedule=schedule)
+    # the timing loop re-feeds one placed batch -> no batch donation
+    step = make_train_step(cfg, opt, schedule=schedule,
+                           donate_batch=False)
 
     dt_get, dt_block, loss = _run_timed_train(
         step, state, _rand_batch(B, S, cfg.vocab_size), steps)
@@ -636,7 +677,7 @@ def bench_recovery():
                 parse_fault_spec(f"rank=0:kind=kill:step={kill_step}"),
                 rank=0, ckpt_manager=mgr)
             try:
-                final, _ = run_training(
+                final, last_m = run_training(
                     state, step_fn, batches, epochs=1,
                     ckpt_manager=mgr, ckpt_every=ckpt_every,
                     heartbeat_fn=lambda step, done=False: beats.append(
@@ -644,7 +685,14 @@ def bench_recovery():
                     fault_injector=inj)
             finally:
                 mgr.close()
-            return {"final_step": int(jax.device_get(final.step))}
+            # the successful (post-resume) attempt's loop timings:
+            # compile_s isolates the retrace+recompile the retry paid,
+            # restart_to_first_step_s additionally covers restore +
+            # resume fast-forward (train/loop.py)
+            return {"final_step": int(jax.device_get(final.step)),
+                    "compile_s": last_m.get("compile_s"),
+                    "restart_to_first_step_s":
+                        last_m.get("restart_to_first_step_s")}
 
         res = JaxTrainer(
             worker, use_ray=False,
@@ -685,15 +733,145 @@ def bench_recovery():
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
+    # recompile vs restore split (ISSUE 4): the retry's first-step cost
+    # decomposes into the retrace+recompile (compile_s — the part the
+    # persistent cache / AOT sidecar eliminates) and everything else
+    # (restore + state rebuild + resume fast-forward)
+    recompile_s = res.metrics.get("compile_s")
+    restart_s = res.metrics.get("restart_to_first_step_s")
     _emit(
         f"time-to-recover injected kill@step{kill_step} -> first "
         f"post-resume step ({cfg.d_model}d/{cfg.n_layers}L seq {S}, "
         f"{devices[0].device_kind})",
         time_to_recover, "s",
         {"sigterm_ckpt_save_s": round(sigterm_save_s, 4),
+         "recompile_s": (round(recompile_s, 4)
+                         if recompile_s is not None else None),
+         "restore_and_ff_s": (round(restart_s - recompile_s, 4)
+                              if None not in (restart_s, recompile_s)
+                              else None),
          "kill_step": kill_step, "resumed_step": int(resumed_step),
          "ckpt_every": ckpt_every, "steps": steps,
          "attempts": res.attempts},
+        compare_baseline=False)
+
+
+def bench_compile():
+    """BENCH_MODE=compile: the compile-once layer's A/B (perf/cache.py),
+    meaningful with NO accelerator attached. One JSON line carries:
+
+    - cold build: trace + lower + XLA compile with an empty persistent
+      cache (what every restart paid before this layer existed);
+    - warm build: identical rebuild after ``jax.clear_caches()`` — the
+      compile hits the persistent cache, only trace+lower re-run;
+    - AOT: ``serialize_executable`` round-trip — a deserialized
+      executable skips trace AND compile (the preempted-retry path),
+      verified bitwise-identical to the jit-built step;
+    - the compile-level StepCostReport + cache hit/miss counters.
+
+    value = cold/warm speedup; the acceptance gate is
+    ``warm_frac_of_cold < 0.3`` (or the AOT fraction, whichever is
+    smaller)."""
+    import dataclasses
+    import tempfile
+
+    from gke_ray_train_tpu.models import llama3_8b
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.perf.cache import (
+        cache_stats, enable_persistent_cache, load_executable,
+        save_executable, aot_signature)
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step,
+        warmup_cosine_schedule)
+    from gke_ray_train_tpu.train.step import batch_shardings
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        size = dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+                    d_ff=5504, vocab_size=32768)
+        B, S = 8, 1024
+    else:
+        size = dict(d_model=256, n_layers=4, n_heads=4, n_kv_heads=2,
+                    d_ff=512, vocab_size=2048)
+        B, S = max(4, n_dev), 256
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-compile-bench", max_seq_len=S,
+        dtype="bfloat16", param_dtype="float32", remat=True,
+        remat_policy=BENCH_REMAT_POLICY, **size)
+    mesh = build_mesh(MeshConfig(data=1, fsdp=-1), devices)
+    schedule = warmup_cosine_schedule(3e-4, 1000)
+    opt = make_optimizer(schedule)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
+                           batch_shardings(mesh))
+
+    cache_dir = os.environ.get("BENCH_COMPILE_CACHE_DIR")
+    scratch = None
+    if not cache_dir:
+        # scratch dir (serialized executables + every cache entry) is
+        # removed at exit — repeated bench runs must not fill /tmp; an
+        # explicit BENCH_COMPILE_CACHE_DIR is kept (A/B across runs)
+        cache_dir = scratch = tempfile.mkdtemp(
+            prefix="bench_compile_cache_")
+    enable_persistent_cache(cache_dir)
+    s0 = cache_stats()
+
+    def build():
+        step = make_train_step(cfg, opt, mesh=mesh, schedule=schedule,
+                               donate=False)
+        t0 = time.perf_counter()
+        compiled = step.lower(state, batch).compile()
+        return compiled, time.perf_counter() - t0
+
+    # cold: empty persistent cache — the full trace+lower+compile cost
+    try:
+        compiled_cold, cold_s = build()
+        # AOT round-trip: serialize the FRESH executable, time deserialize
+        aot_path = os.path.join(cache_dir, "bench_aot_step.bin")
+        key = aot_signature(state, batch)
+        aot = {"aot_serialized": save_executable(compiled_cold, aot_path,
+                                                 key)}
+        if aot["aot_serialized"]:
+            t0 = time.perf_counter()
+            loaded = load_executable(aot_path, key)
+            deser_s = time.perf_counter() - t0
+            aot["aot_deserialize_s"] = round(deser_s, 4)
+            aot["aot_frac_of_cold"] = round(deser_s / cold_s, 4)
+            if loaded is not None:
+                _, m_a = loaded(state, batch)
+                _, m_b = compiled_cold(state, batch)
+                aot["aot_loss_bitwise_equal"] = bool(
+                    jnp.array_equal(m_a["loss"], m_b["loss"]))
+            else:
+                aot["aot_deserialize_failed"] = True
+        # warm: identical rebuild, in-memory jit caches dropped — the
+        # compile consults the persistent cache, only trace+lower re-run
+        jax.clear_caches()
+        _compiled_warm, warm_s = build()
+        s1 = cache_stats()
+    finally:
+        if scratch is not None:
+            import shutil
+            shutil.rmtree(scratch, ignore_errors=True)
+
+    cost = step_cost_report(compiled_cold, tokens_per_step=B * S)
+    _emit(
+        f"compile-cache speedup cold vs warm train-step build "
+        f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, "
+        f"{devices[0].device_kind} x{n_dev})",
+        cold_s / max(warm_s, 1e-9), "x",
+        {"cold_build_s": round(cold_s, 3),
+         "warm_build_s": round(warm_s, 3),
+         "warm_frac_of_cold": round(warm_s / cold_s, 4),
+         "cache_hits": int(s1["hits"] - s0["hits"]),
+         "cache_misses": int(s1["misses"] - s0["misses"]),
+         "compile_time_saved_s": round(
+             s1["compile_time_saved_s"] - s0["compile_time_saved_s"], 3),
+         **aot,
+         "cost_report": cost.summary()},
         compare_baseline=False)
 
 
@@ -753,12 +931,34 @@ def main():
                  if "BENCH_BACKEND_TIMEOUT_S" in os.environ else None)
     status, detail = graft._probe_backend(timeout_s=timeout_s)
     if status != "ok":
-        print(json.dumps({
-            "metric": f"bench {mode} NOT RUN - accelerator backend "
-                      f"{status}",
-            "value": 0.0, "unit": "error", "vs_baseline": 0.0,
-            "error": str(detail).replace("\n", " ")[:200]}))
-        sys.exit(1)
+        if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+            # the fallback child itself cannot bring a backend up —
+            # only now is an error record the honest output
+            print(json.dumps({
+                "metric": f"bench {mode} NOT RUN - accelerator backend "
+                          f"{status}",
+                "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+                "error": str(detail).replace("\n", " ")[:200]}))
+            sys.exit(1)
+        # dead accelerator → re-exec on the 8-fake-device CPU mesh and
+        # still emit a VALID record (tagged "backend": "cpu-fallback")
+        # — compile-level cost numbers + CPU proxy tok/s keep the BENCH
+        # trajectory populated instead of the r4-r5 error JSONs
+        print(f"bench: accelerator backend {status} ({detail}); "
+              "re-exec on the 8-device CPU fallback mesh",
+              file=sys.stderr)
+        import subprocess
+
+        from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+        env = cpu_mesh_env(
+            GRAFT_CPU_FALLBACK="1", BENCH_CPU_FALLBACK="1",
+            BENCH_FALLBACK_REASON=f"{status}: {detail}")
+        # the child is committed to CPU — a forced/poisoned probe env
+        # must not cascade into it
+        env.pop("GRAFT_FORCE_PROBE", None)
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
     {"train": bench_train, "qlora8b": bench_qlora8b,
      "mistral7b-lora": bench_mistral7b_lora,
      "gemma2-4k": bench_gemma2_4k,
@@ -766,6 +966,7 @@ def main():
      "qwen2-lora": bench_qwen2_lora,
      "input-bound": bench_input_bound,
      "recovery": bench_recovery,
+     "compile": bench_compile,
      "decode": bench_decode}[mode]()
 
 

@@ -48,6 +48,10 @@ KNOWN_KEYS = frozenset({
     # input pipeline (data/prefetch.py): queue depth of the background
     # prefetch+placement thread; 0 = synchronous
     "PREFETCH_BATCHES",
+    # compile-once layer (perf/cache.py): persistent XLA cache dir on
+    # shared storage, and the AOT train-step executable persisted
+    # beside the checkpoint (1/default = on)
+    "COMPILE_CACHE_DIR", "AOT_TRAIN_STEP",
     # inference comparison
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",

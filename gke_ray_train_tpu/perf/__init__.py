@@ -1,0 +1,32 @@
+"""Compile-once performance layer (VERDICT r5: hardware-independent
+compile-level guarantees).
+
+Three modules, one goal — compilation is a one-time cost and per-step
+cost/memory/collective footprints are asserted quantities:
+
+- :mod:`cache` — JAX persistent compilation cache on shared storage
+  (``COMPILE_CACHE_DIR``) with topology hygiene, plus AOT
+  ``jit(...).lower(...).compile()`` builds persisted beside the
+  checkpoint so a preempted retry deserializes the executable instead
+  of retracing.
+- :mod:`costs` — ``StepCostReport``: flops/step, HBM bytes, peak
+  temp/argument/output memory, collective count & bytes, analytic MFU
+  ceiling — all computed from the AOT lowering, no accelerator needed.
+- :mod:`budget` — checked-in per-preset budget JSONs + a comparator
+  with tolerances; a budget miss (remat silently off, an extra
+  all-reduce in the grad path, peak-memory growth) fails tier-1 tests
+  and prints the offending HLO delta.
+"""
+
+from gke_ray_train_tpu.perf.cache import (  # noqa: F401
+    aot_signature, build_or_load_step, cache_stats, enable_persistent_cache,
+    load_executable, log_cache_summary, save_executable,
+    topology_fingerprint)
+from gke_ray_train_tpu.perf.costs import (  # noqa: F401
+    ChipSpec, StepCostReport, chip_spec_for_devices, collective_stats,
+    step_cost_report)
+
+# perf.budget is NOT imported eagerly: it doubles as the re-baseline CLI
+# (`python -m gke_ray_train_tpu.perf.budget`), and runpy warns when the
+# target module was already materialized by its package __init__
+

@@ -1,0 +1,289 @@
+"""Per-preset compile-cost budgets — regression gates, not benchmarks.
+
+A budget JSON (checked in under ``tests/budgets/``) pins the
+:class:`~gke_ray_train_tpu.perf.costs.StepCostReport` of a named preset
+(model + mesh + batch shape) as recorded on the 8-fake-device CPU mesh —
+the same mesh tier-1 CI runs on, so the comparator needs no hardware.
+The comparator flags, with tolerances:
+
+- **flops / bytes drift** (two-sided: a remat policy silently turning
+  OFF *drops* flops while blowing up peak memory);
+- **peak temp-memory growth** (the remat / activation-liveness signal);
+- **any change in collective count by kind** — an extra all-reduce in
+  the grad path is exactly the class of silent perf bug GSPMD can
+  introduce; the violation message prints the offending HLO lines
+  (the delta against the lines recorded in the budget).
+
+Re-baselining after an INTENTIONAL change:
+``python -m gke_ray_train_tpu.perf.budget record`` rewrites the files
+(it re-execs itself onto the canonical CPU mesh), or run the tier-1
+budget test with ``BUDGET_UPDATE=1``. Review the JSON diff like code —
+that diff *is* the perf review.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Union
+
+from gke_ray_train_tpu.perf.costs import (
+    COLLECTIVE_KINDS, StepCostReport, step_cost_report)
+
+# two-sided relative tolerances; collective COUNTS are exact by design
+DEFAULT_TOLERANCES = {
+    "flops": 0.05,
+    "bytes_accessed": 0.25,
+    "temp_bytes": 0.25,
+    "argument_bytes": 0.05,
+    "output_bytes": 0.05,
+    "collective_bytes": 0.25,
+}
+
+BUDGET_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tests", "budgets")
+
+
+class BudgetViolation(AssertionError):
+    """A compiled step broke its checked-in cost/memory budget."""
+
+
+def load_budget(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def write_budget(report: Union[StepCostReport, Dict[str, Any]], path: str,
+                 *, preset: str = "", note: str = "") -> Dict[str, Any]:
+    if isinstance(report, StepCostReport):
+        report = report.to_dict()
+    import jax
+    doc = {
+        "_preset": preset,
+        "_note": note or ("re-baseline with: python -m "
+                          "gke_ray_train_tpu.perf.budget record"),
+        "_recorded_with": {"jax": jax.__version__,
+                           "platform": jax.devices()[0].platform,
+                           "n_devices": len(jax.devices())},
+        **{k: v for k, v in report.items() if not k.startswith("_")},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def _rel_diff(a: float, b: float) -> float:
+    if b == 0:
+        return 0.0 if a == 0 else float("inf")
+    return abs(a - b) / abs(b)
+
+
+def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
+                      budget: Dict[str, Any],
+                      tolerances: Optional[Dict[str, float]] = None
+                      ) -> List[str]:
+    """Violation strings (empty = within budget). Scalar fields use
+    two-sided relative tolerances; collective counts are exact, and a
+    count mismatch carries the HLO-line delta so the offending op is
+    named, not just counted."""
+    if isinstance(report, StepCostReport):
+        report = report.to_dict()
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(budget.get("tolerances", {}))
+    tol.update(tolerances or {})
+    viols: List[str] = []
+    for field, t in tol.items():
+        if field not in budget or field not in report:
+            continue
+        have, want = float(report[field]), float(budget[field])
+        d = _rel_diff(have, want)
+        if d > t:
+            viols.append(
+                f"{field}: {have:.4g} vs budget {want:.4g} "
+                f"({'+' if have > want else '-'}{d:.1%}, tolerance "
+                f"{t:.0%})")
+
+    want_counts = budget.get("collective_counts")
+    if want_counts is not None:
+        have_counts = report.get("collective_counts", {})
+        mismatched = [
+            k for k in COLLECTIVE_KINDS
+            if int(have_counts.get(k, 0)) != int(want_counts.get(k, 0))]
+        if mismatched:
+            detail = ", ".join(
+                f"{k}: {have_counts.get(k, 0)} vs budget "
+                f"{want_counts.get(k, 0)}" for k in mismatched)
+            viols.append(f"collective counts changed ({detail})")
+            viols.extend(_hlo_delta(report.get("collective_lines", []),
+                                    budget.get("collective_lines", [])))
+    return viols
+
+
+def _hlo_delta(have_lines: List[str], want_lines: List[str],
+               cap: int = 8) -> List[str]:
+    """The offending HLO delta: collective lines present on one side
+    only (multiset diff, op names normalized away so textual id drift
+    between compiles does not flood the report)."""
+    import re
+
+    def norm(line):
+        return re.sub(r"%[\w.\-]+", "%_", line)
+
+    have = [norm(x) for x in have_lines]
+    want = [norm(x) for x in want_lines]
+    out: List[str] = []
+    added = list(have)
+    for w in want:
+        if w in added:
+            added.remove(w)
+    removed = list(want)
+    for h in have:
+        if h in removed:
+            removed.remove(h)
+    for tag, lines in (("+", added), ("-", removed)):
+        for ln in lines[:cap]:
+            out.append(f"  HLO {tag} {ln}")
+        if len(lines) > cap:
+            out.append(f"  HLO {tag} ... {len(lines) - cap} more")
+    return out
+
+
+def assert_within_budget(report: Union[StepCostReport, Dict[str, Any]],
+                         budget_path: str, **kw) -> None:
+    viols = compare_to_budget(report, load_budget(budget_path), **kw)
+    if viols:
+        raise BudgetViolation(
+            f"compiled step broke the budget {budget_path}:\n  "
+            + "\n  ".join(viols)
+            + "\nIf the change is INTENTIONAL, re-baseline: python -m "
+              "gke_ray_train_tpu.perf.budget record")
+
+
+# ---------------------------------------------------------------------------
+# Presets — the shapes whose budgets are checked in under tests/budgets/
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Preset:
+    name: str
+    mesh: Dict[str, int]
+    batch: int = 8
+    seq: int = 64
+    remat: bool = True
+
+
+PRESETS = {
+    # fsdp grad path: reduce-scatter/all-gather family under GSPMD
+    "tiny_fsdp8": Preset("tiny_fsdp8", {"data": 2, "fsdp": 4}),
+    # pure data-parallel grad path: the classic gradient all-reduce
+    "tiny_dp8": Preset("tiny_dp8", {"data": 8, "fsdp": 1}),
+}
+
+
+def build_preset_step(preset: Union[str, Preset], *, remat=None,
+                      wrap=None):
+    """(compiled, state, batch) for a preset on the current devices —
+    the deterministic compile whose report the budget pins.
+
+    ``wrap(unjitted_step) -> fn``: transform the step before jit — the
+    regression tests use it to deliberately smuggle an extra collective
+    into the grad path and prove the comparator catches it."""
+    import jax
+    import jax.numpy as jnp
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.step import batch_shardings
+
+    p = PRESETS[preset] if isinstance(preset, str) else preset
+    mesh = build_mesh(MeshConfig(**p.mesh), jax.devices())
+    cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=128,
+               vocab_size=256, max_seq_len=p.seq,
+               remat=p.remat if remat is None else remat)
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    # donate=False: budgets must not vary with backend donation support
+    step = make_train_step(cfg, opt, mesh=mesh, donate=False)
+    if wrap is not None:
+        step = jax.jit(wrap(step.__wrapped__))
+    batch = jax.device_put(
+        {"inputs": jnp.zeros((p.batch, p.seq), jnp.int32),
+         "targets": jnp.zeros((p.batch, p.seq), jnp.int32),
+         "weights": jnp.ones((p.batch, p.seq), jnp.float32)},
+        batch_shardings(mesh))
+    compiled = step.lower(state, batch).compile()
+    return compiled, state, batch
+
+
+def build_preset_report(preset: Union[str, Preset],
+                        *, remat=None) -> StepCostReport:
+    p = PRESETS[preset] if isinstance(preset, str) else preset
+    compiled, _, _ = build_preset_step(p, remat=remat)
+    return step_cost_report(compiled, tokens_per_step=p.batch * p.seq)
+
+
+def budget_path(name: str, budget_dir: Optional[str] = None) -> str:
+    return os.path.join(budget_dir or BUDGET_DIR, f"{name}.json")
+
+
+# ---------------------------------------------------------------------------
+# CLI: record / check on the canonical 8-fake-device CPU mesh
+# ---------------------------------------------------------------------------
+
+def _reexec_on_cpu_mesh(argv) -> int:
+    """Budgets are only comparable on the canonical mesh; re-exec this
+    CLI in a child whose backend is forced to 8 CPU devices."""
+    from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+    return subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.perf.budget"] + argv,
+        env=cpu_mesh_env(_BUDGET_CLI_NATIVE="1")).returncode
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m gke_ray_train_tpu.perf.budget",
+        description="record/check compile-cost budgets on the canonical "
+                    "8-fake-device CPU mesh")
+    parser.add_argument("command", choices=("record", "check"))
+    parser.add_argument("names", nargs="*",
+                        help=f"presets (default: all of "
+                             f"{sorted(PRESETS)})")
+    parser.add_argument("--dir", default=BUDGET_DIR,
+                        help="budget directory (default tests/budgets)")
+    args = parser.parse_args(argv)
+    if os.environ.get("_BUDGET_CLI_NATIVE") != "1":
+        return _reexec_on_cpu_mesh(
+            [args.command] + args.names + ["--dir", args.dir])
+
+    import jax
+    assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, \
+        "budget CLI must run on the 8-fake-device CPU mesh"
+    names = args.names or sorted(PRESETS)
+    rc = 0
+    for name in names:
+        report = build_preset_report(name)
+        path = budget_path(name, args.dir)
+        if args.command == "record":
+            write_budget(report, path, preset=name)
+            print(f"recorded {path}")
+        else:
+            try:
+                assert_within_budget(report, path)
+                print(f"{name}: within budget")
+            except BudgetViolation as e:
+                print(e)
+                rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

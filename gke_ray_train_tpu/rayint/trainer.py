@@ -183,8 +183,16 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     payload because on the Ray path the worker context lives in another
     process and the driver could not read it otherwise."""
     os.environ.update(env)
+    from gke_ray_train_tpu.perf.cache import (
+        enable_persistent_cache, log_cache_summary)
     from gke_ray_train_tpu.rayint.context import get_context
     from gke_ray_train_tpu.train import preempt
+    # compile-once across restarts: every attempt (and every retry of a
+    # preempted worker) reuses the persistent XLA cache instead of
+    # paying a full recompile. Config-only here — the backend must not
+    # initialize before distributed_init; the entry scripts re-enable
+    # after it so the cache dir gains the real topology fingerprint.
+    enable_persistent_cache()
     ctx = get_context()
     ctx.resumed_step = None      # fresh attempt, fresh metadata
     ctx.set_heartbeat_sink(beat_fn)
@@ -196,6 +204,9 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         return {"metrics": ret if ret is not None else (reported or {}),
                 "resumed_step": ctx.resumed_step}
     finally:
+        # one line of compile-cache health per attempt: a warm restart
+        # should show hits ≈ compile count and seconds saved
+        log_cache_summary(logger)
         # a finished (or failed — its error surfaces via the future)
         # worker must never be reported as stalled
         ctx.heartbeat_done()
@@ -373,6 +384,14 @@ class JaxTrainer:
                 "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
                 "NUM_PROCESSES": str(n),
             }
+            # compile-cache knobs ride to the workers explicitly — a
+            # driver-side `env COMPILE_CACHE_DIR=...` must shape the
+            # workers' cache even without a Ray runtime-env entry
+            env_base.update({
+                k: os.environ[k]
+                for k in ("COMPILE_CACHE_DIR", "COMPILE_CACHE",
+                          "AOT_TRAIN_STEP")
+                if k in os.environ})
             futures = [
                 w.run.remote(self.fn, self.config,
                              {**env_base, "PROCESS_ID": str(i)}, supervisor)

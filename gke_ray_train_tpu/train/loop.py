@@ -57,6 +57,13 @@ def run_training(state: TrainState,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
+    last_metrics carries two compile-level timings alongside the step
+    metrics: ``compile_s`` (wall time of the first step call incl. its
+    trace+compile — near zero under a warm persistent compile cache or
+    a deserialized AOT executable, perf/cache.py) and
+    ``restart_to_first_step_s`` (run_training entry → first completed
+    step: restore + fast-forward + compile; the recovery-path metric).
+
     epoch_batches(epoch) → iterable of host-local numpy batch dicts.
     place_batch(batch) → device arrays (sharded form-up); default asis.
     prefetch: queue depth of the asynchronous input pipeline
@@ -90,6 +97,14 @@ def run_training(state: TrainState,
     durable, and raises Preempted — the trainer retries WITHOUT
     consuming the max_failures budget.
     """
+    # time-to-first-step accounting (BENCH_MODE=compile / recovery):
+    # the clock starts BEFORE the checkpoint restore below — at 8B scale
+    # restore is the other dominant term besides compile, and
+    # restart_to_first_step_s must cover restore + fast-forward +
+    # compile (compile_s isolates the first step call, ≈0 when the step
+    # is a deserialized AOT executable; perf/cache.py)
+    t_loop0 = time.perf_counter()
+    loop_timing: dict = {}
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
     load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
     if fault_injector is None:
@@ -230,7 +245,19 @@ def run_training(state: TrainState,
             elif meter is not None:
                 meter.data_wait(wait_s)
             trained_this_epoch += 1
-            state, m = train_step(state, batch)
+            if not loop_timing:
+                t_step0 = time.perf_counter()
+                state, m = train_step(state, batch)
+                # block: the first call's wall time must cover the
+                # compile it triggered, not just the async dispatch
+                jax.block_until_ready(m["loss"])
+                now = time.perf_counter()
+                loop_timing = {
+                    "compile_s": now - t_step0,
+                    "restart_to_first_step_s": now - t_loop0,
+                }
+            else:
+                state, m = train_step(state, batch)
             global_step += 1
             if heartbeat_fn is not None:
                 # step-granular liveness: the metric the supervisor
@@ -244,7 +271,8 @@ def run_training(state: TrainState,
                 meter.update(int(np.prod(batch["inputs"].shape)))
             if log_every and global_step % log_every == 0:
                 m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
-                last_metrics = {"epoch": epoch, "step": global_step, **m_host}
+                last_metrics = {"epoch": epoch, "step": global_step,
+                                **loop_timing, **m_host}
                 if meter is not None:
                     last_metrics.update(meter.snapshot())
                 if tb_writer is not None:
@@ -311,7 +339,8 @@ def run_training(state: TrainState,
                 "smaller than one global batch (shrink GLOBAL_BATCH / "
                 "PER_DEVICE_TRAIN_BATCH_SIZE or grow the dataset)")
         m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
-        epoch_metrics = {"epoch": epoch, "step": global_step, **m_host}
+        epoch_metrics = {"epoch": epoch, "step": global_step,
+                         **loop_timing, **m_host}
         if meter is not None:
             epoch_metrics.update(meter.snapshot())
         if eval_fn is not None and eval_at_epoch_end:

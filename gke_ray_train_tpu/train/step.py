@@ -145,6 +145,7 @@ def make_train_step(cfg: ModelConfig,
                     grad_accum: int = 1,
                     schedule: Optional[Callable] = None,
                     donate: bool = True,
+                    donate_batch: bool = True,
                     pipe_microbatches: Optional[int] = None
                     ) -> Callable[[TrainState, Batch], tuple]:
     """Build the jitted ``(state, batch) -> (state, metrics)`` function.
@@ -152,6 +153,14 @@ def make_train_step(cfg: ModelConfig,
     batch: dict with "inputs"/"targets" [B, S] int32, "weights" [B, S]
     float, optional "segment_ids"/"positions" [B, S]. B must be divisible
     by grad_accum; microbatches are scanned in sequence.
+
+    ``donate_batch`` (with ``donate``): the batch argument is donated
+    too — each step's device-resident batch buffers are freed eagerly
+    instead of surviving until the Python reference dies. The input
+    pipeline owns its own host copies and never re-feeds a placed batch
+    (data/prefetch.py), so this is pure peak-memory headroom. Pass
+    False when the SAME placed batch is fed repeatedly (bench timing
+    loops) — a donated buffer must not be reused.
 
     ``pipe_microbatches``: pipeline microbatch count per forward when the
     mesh has a pipe axis > 1 (models/pipeline.py; default = stage count).
@@ -245,14 +254,32 @@ def make_train_step(cfg: ModelConfig,
             metrics["learning_rate"] = schedule(state.step)
         return new_state, metrics
 
-    return jax.jit(train_step, donate_argnums=(0,) if donate else ())
+    argnums = (0, 1) if (donate and donate_batch) else \
+        ((0,) if donate else ())
+    fn = jax.jit(train_step, donate_argnums=argnums)
+    try:
+        # introspection hook for tests/tooling: jit wrappers do not
+        # expose their donate_argnums publicly
+        fn.donate_argnums = argnums
+    except (AttributeError, TypeError):  # pragma: no cover - frozen type
+        pass
+    return fn
 
 
 def make_eval_step(cfg: ModelConfig, *, mesh: Optional[Mesh] = None,
                    lora_cfg: Optional[LoraConfig] = None,
-                   pipe_microbatches: Optional[int] = None):
+                   pipe_microbatches: Optional[int] = None,
+                   batch_shardings: Optional[Dict[str, Any]] = None):
     """(state, batch) -> summed (nll, weight) — callers aggregate across
-    batches/hosts then divide (exact eval loss, SURVEY.md §5.5)."""
+    batches/hosts then divide (exact eval loss, SURVEY.md §5.5).
+
+    ``batch_shardings``: explicit per-key input shardings for the batch
+    (the same :func:`batch_shardings` contract as the train step). With
+    them pinned, eval compiles ONCE for the declared layout — numpy
+    rows, pre-placed arrays, or arrays committed elsewhere all dispatch
+    into that one executable instead of retracing per distinct input
+    layout, and on multi-host meshes the batch is batch-axis-sharded by
+    construction rather than silently replicated."""
     lora_mode = lora_cfg is not None
 
     def eval_step(state: TrainState, batch: Batch):
@@ -265,6 +292,10 @@ def make_eval_step(cfg: ModelConfig, *, mesh: Optional[Mesh] = None,
                          pipe_microbatches=pipe_microbatches)
         return token_nll(logits, batch["targets"], batch["weights"])
 
+    if batch_shardings is not None:
+        # None = leave the state's shardings to propagate from the args
+        return jax.jit(eval_step,
+                       in_shardings=(None, dict(batch_shardings)))
     return jax.jit(eval_step)
 
 

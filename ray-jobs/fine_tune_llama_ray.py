@@ -69,6 +69,11 @@ def train_loop_per_worker(config: dict):
                                # silently dropped
     apply_debug_flags(config)
     distributed_init()
+    # persistent XLA compile cache (perf/cache.py): restarts and peer
+    # hosts reuse the compiled binary; re-enabled post-init so the
+    # cache dir carries the real device-topology fingerprint
+    from gke_ray_train_tpu.perf.cache import enable_persistent_cache
+    enable_persistent_cache(config.get("COMPILE_CACHE_DIR"))
     mesh = build_mesh(MeshConfig.from_dict(config))
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
@@ -264,11 +269,32 @@ def train_loop_per_worker(config: dict):
     step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
                               grad_accum=grad_accum, schedule=schedule,
                               pipe_microbatches=pipe_micro)
-    eval_fn_step = make_eval_step(cfg, mesh=mesh, lora_cfg=lora_cfg,
-                                  pipe_microbatches=pipe_micro)
-
+    # explicit batch shardings pin eval to ONE compiled layout (no
+    # retrace per distinct batch placement, no silent replication on
+    # multi-host meshes) — the same contract the train step gets from
+    # make_place_batch
+    from gke_ray_train_tpu.train.step import batch_shardings
+    ctx_sharded = mesh.shape["context"] > 1
+    eval_fn_step = make_eval_step(
+        cfg, mesh=mesh, lora_cfg=lora_cfg, pipe_microbatches=pipe_micro,
+        batch_shardings=batch_shardings(
+            mesh, ("inputs", "targets", "weights"),
+            context_sharded=ctx_sharded))
     out_base = config.get("OUTPUT_DIR_BASE", "/tmp/grt_sft")
     sft_dir = os.path.join(out_base, config.get("SFT_SUBDIR_NAME", "sft"))
+    # AOT train executable beside the checkpoint (perf/cache.py): a
+    # preempted retry deserializes it and reaches its first step with
+    # zero retracing; signature drift falls back to the jitted step
+    from gke_ray_train_tpu.perf.cache import (
+        aot_enabled, build_or_load_step, make_abstract_batch)
+    if aot_enabled(config):
+        step_fn = build_or_load_step(
+            step_fn, state,
+            make_abstract_batch(mesh, global_batch, max_seq,
+                                packed=packing,
+                                context_sharded=ctx_sharded),
+            sidecar=os.path.join(sft_dir, "aot_train_step.bin"),
+            label="sft train_step")
     # SAVE_STRATEGY / EVALUATION_STRATEGY_SFT honored (config.py;
     # reference fine_tune_config.json:22-25)
     cadence = cadence_from_config(config)
@@ -325,7 +351,6 @@ def train_loop_per_worker(config: dict):
         )
     # multi-host batch form-up (SURVEY.md row D9): host-local rows →
     # global sharded arrays; identical path single-host
-    ctx_sharded = mesh.shape["context"] > 1
     place = make_place_batch(mesh, context_sharded=ctx_sharded)
 
     state, metrics = run_training(

@@ -50,6 +50,12 @@ def train_loop_per_worker(config: dict):
 
     ctx = get_context()
     distributed_init()
+    # persistent XLA compile cache on the shared PVC: the first worker
+    # to compile pays; every restart (and every other host) reuses the
+    # binary. Re-enabled here (the trainer already enabled it pre-init)
+    # so the cache dir carries the real device-topology fingerprint.
+    from gke_ray_train_tpu.perf.cache import enable_persistent_cache
+    enable_persistent_cache(config.get("COMPILE_CACHE_DIR"))
     mesh = build_mesh(MeshConfig.from_dict(config))
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
@@ -116,6 +122,18 @@ def train_loop_per_worker(config: dict):
     run_dir = os.path.join(
         config.get("storage_path", "/mnt/pvc/ray_llm_training_runs"),
         config.get("run_name", "basic_lm"))
+    # AOT train executable beside the checkpoint (perf/cache.py): build
+    # once via jit(...).lower(...).compile() and serialize; a preempted
+    # retry deserializes it and reaches its first step without
+    # retracing. Any signature drift falls back to the jitted step.
+    from gke_ray_train_tpu.perf.cache import (
+        aot_enabled, build_or_load_step, make_abstract_batch)
+    if aot_enabled(config):
+        step_fn = build_or_load_step(
+            step_fn, state, make_abstract_batch(mesh, global_batch,
+                                                seq_len),
+            sidecar=os.path.join(run_dir, "aot_train_step.bin"),
+            label="pretrain train_step")
     # recency retention, keep 2 (NOT the reference's keep-1-best): the
     # training manager exists to RESUME — best-by-loss retention would
     # garbage-collect a grace-window preemption save whose loss is not

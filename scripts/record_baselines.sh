@@ -36,8 +36,19 @@ run decode         env BENCH_MODE=decode python bench.py
 
 # fault-tolerance drill: time-to-recover (injected kill -> first
 # post-resume step) + checkpoint-save latency under SIGTERM (must fit
-# the preemption grace window)
+# the preemption grace window); the record splits recompile time from
+# restore+fast-forward time
 run recovery       env BENCH_MODE=recovery python bench.py
+
+# compile-once layer (perf/): cold build vs warm persistent-cache build
+# vs deserialized AOT executable, + the compile-level StepCostReport
+run compile        env BENCH_MODE=compile python bench.py
+
+# compile-cost budgets (tests/budgets/*.json) are recorded on the
+# canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI
+# re-execs itself there; `check` is what tier-1 runs. Only re-record
+# after an INTENTIONAL cost change, and review the JSON diff like code.
+run budget-check   python -m gke_ray_train_tpu.perf.budget check
 
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
 # defaults on the seq4k shape where the kernel dominates (up to 8 extra

@@ -20,6 +20,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # (rayint/trainer.py); the suite's deliberate-failure tests must not
 # each pay real sleeps
 os.environ.setdefault("RETRY_BACKOFF_S", "0")
+# the trainer enables the persistent compile cache in every worker
+# (perf/cache.py); under the suite that would persist every tiny test
+# executable to /mnt/pvc or ~/.cache and warm-poison later cold-compile
+# measurements on the same machine. Tests that WANT the cache (
+# tests/test_perf.py) re-enable it into a sandbox dir explicitly.
+os.environ.setdefault("COMPILE_CACHE", "0")
 
 import jax  # noqa: E402
 

@@ -104,8 +104,9 @@ def test_qlora_train_step_loss_decreases():
     qparams = quantize_params(state.params, "nf4")
     state = TrainState(params=qparams, lora=state.lora,
                        opt_state=state.opt_state, step=state.step)
+    # donate_batch=False: the loop below re-feeds one placed batch
     step = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
-                           schedule=sch)
+                           schedule=sch, donate_batch=False)
     B, S = 4, 32
     batch = {
         "inputs": jax.random.randint(jax.random.key(1), (B, S), 0, 64),
