@@ -1,0 +1,29 @@
+"""shardlint — three-level sharding & host-sync static analysis.
+
+Level 1 (:mod:`analysis.astlint`): AST rules TPU001–TPU005 over the
+repo's own source — host-syncs in jit-reachable code, PartitionSpec
+axis typos, undonated step fns, impure traced code, host-data
+constants — with reasoned inline suppressions.
+
+Level 2 (:mod:`analysis.jaxprcheck`): the presets' real step functions
+lowered/compiled on the 8-fake-device CPU mesh and checked against
+XLA's own ledger — no unbudgeted reshard collectives, donation held,
+one compile per function (with the signature diff when not).
+
+Level 3 (:mod:`analysis.guards`): opt-in production teeth —
+``TRANSFER_GUARD`` wraps the hot loop, ``RECOMPILE_LIMIT`` makes
+retrace churn a hard error, ``DIVERGENCE_GUARD`` fails fast (with a
+per-host diff) when multi-host step programs diverge.
+
+CLI: ``python -m gke_ray_train_tpu.analysis lint|trace|check``.
+"""
+
+from gke_ray_train_tpu.analysis.astlint import (  # noqa: F401
+    Finding, RULES, lint_paths, lint_source, lint_sources)
+from gke_ray_train_tpu.analysis.jaxprcheck import (  # noqa: F401
+    RecompileDetector, check_preset, donation_findings, trace_preset,
+    unbudgeted_collectives)
+from gke_ray_train_tpu.analysis.guards import (  # noqa: F401
+    GuardViolation, HloDivergenceError, RecompileLimitExceeded,
+    RuntimeGuards, allow_transfers, check_host_hlo_agreement,
+    install_recompile_limit, uninstall_recompile_limit)

@@ -1,0 +1,118 @@
+"""The shardlint CLI — ``python -m gke_ray_train_tpu.analysis``.
+
+``lint``   AST pass (level 1) over the repo source; exit 1 on findings.
+``trace``  print the level-2 compile ledger per preset (informational).
+``check``  level-2 assertions per preset (unbudgeted collectives,
+           dropped donation, recompiles); exit 1 on findings.
+
+``trace``/``check`` need the canonical 8-fake-device CPU mesh, so —
+like ``perf.budget`` — they re-exec themselves into a child with the
+forced-CPU env when not already on it. ``lint`` is pure AST and runs
+anywhere (the CI lint step needs no jax backend at all).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the repo's runtime surface; tests/ are deliberately excluded (their
+# fixtures CONTAIN the bad snippets the rules must keep catching)
+DEFAULT_LINT_PATHS = ("gke_ray_train_tpu", "ray-jobs", "bench.py",
+                      "__graft_entry__.py")
+
+
+def _lint(paths: List[str]) -> int:
+    from gke_ray_train_tpu.analysis.astlint import lint_paths
+    paths = paths or [os.path.join(REPO_ROOT, p)
+                      for p in DEFAULT_LINT_PATHS
+                      if os.path.exists(os.path.join(REPO_ROOT, p))]
+    findings = lint_paths(paths)
+    for f in findings:
+        path = os.path.relpath(f.path, REPO_ROOT) \
+            if os.path.isabs(f.path) else f.path
+        print(f"{path}:{f.line}:{f.col}: {f.code} {f.message}")
+    n = len(findings)
+    print(f"shardlint: {n} finding(s)" if n else "shardlint: clean")
+    # findings always fail the lint verb; the --fail-on-findings flag
+    # exists so the CI step states its contract explicitly
+    return 1 if findings else 0
+
+
+def _preset_names(names: List[str]) -> List[str]:
+    from gke_ray_train_tpu.perf.budget import PRESETS
+    return names or sorted(PRESETS)
+
+
+def _reexec_on_cpu_mesh(argv: List[str]) -> int:
+    from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+    return subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.analysis"] + argv,
+        env=cpu_mesh_env(_ANALYSIS_CLI_NATIVE="1"), cwd=REPO_ROOT
+    ).returncode
+
+
+def _on_canonical_mesh() -> bool:
+    import jax
+    return jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8
+
+
+def _trace(names: List[str]) -> int:
+    from gke_ray_train_tpu.analysis.jaxprcheck import trace_preset
+    for name in _preset_names(names):
+        print(trace_preset(name))
+    return 0
+
+
+def _check(names: List[str]) -> int:
+    from gke_ray_train_tpu.analysis.jaxprcheck import check_preset
+    rc = 0
+    for name in _preset_names(names):
+        findings = check_preset(name)
+        for f in findings:
+            print(f"FINDING {f}")
+        if findings:
+            rc = 1
+        else:
+            print(f"{name}: clean (collectives within budget, donation "
+                  "held, one compile per fn)")
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m gke_ray_train_tpu.analysis",
+        description="shardlint: sharding & host-sync static analysis "
+                    "(AST lint / trace-level analyzers, CPU-only)")
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_lint = sub.add_parser("lint", help="AST rules TPU001-TPU005")
+    p_lint.add_argument("paths", nargs="*",
+                        help="files/dirs (default: the repo's runtime "
+                             "surface, tests excluded)")
+    p_lint.add_argument("--fail-on-findings", action="store_true",
+                        help="exit 1 on any finding (also the default)")
+    p_trace = sub.add_parser(
+        "trace", help="print the compile-level ledger per preset")
+    p_trace.add_argument("names", nargs="*")
+    p_check = sub.add_parser(
+        "check", help="assert collectives/donation/compile-once per preset")
+    p_check.add_argument("names", nargs="*")
+    args = parser.parse_args(argv)
+
+    if args.command == "lint":
+        return _lint(args.paths)
+    if os.environ.get("_ANALYSIS_CLI_NATIVE") != "1" \
+            and not _on_canonical_mesh():
+        return _reexec_on_cpu_mesh([args.command] + args.names)
+    return _trace(args.names) if args.command == "trace" \
+        else _check(args.names)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

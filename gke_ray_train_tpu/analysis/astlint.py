@@ -1,0 +1,580 @@
+"""shardlint level 1 — AST rules over the repo's own source.
+
+Catches the sharding/host-sync bug classes that destroy TPU throughput
+*before any code runs*, on a CPU-only CI runner:
+
+========  ==========================================================
+code      what it catches
+========  ==========================================================
+TPU001    host-sync in the hot path: ``.item()`` / ``float()`` /
+          ``np.asarray`` / ``jax.device_get`` /
+          ``jax.block_until_ready`` inside a jit-reachable function
+          (anything transitively called from a ``train_step`` /
+          ``eval_step`` body or passed to a tracing transform), and
+          per-element ``jax.device_get`` inside a loop/comprehension
+          anywhere (N round-trips where one batched fetch of the
+          tree would do)
+TPU002    ``PartitionSpec`` axis name outside the mesh-axis
+          vocabulary declared by ``parallel/mesh.py`` — a
+          ``P("fsdb", None)`` typo silently REPLICATES the tensor
+TPU003    jitted step-like function (takes a state pytree, returns
+          one) without ``donate_argnums`` — doubles peak HBM for
+          params + optimizer state
+TPU004    impure calls in traced code (``np.random.*``,
+          ``time.time()``, ``random.*``) — baked in as constants at
+          trace time, the bug class the runtime bans elsewhere
+TPU005    ``jnp.array(...)`` of Python/host data inside a traced
+          function — hidden host→device transfer re-staged every
+          trace, plus constant-folding blowup in XLA
+TPU000    a ``# shardlint: disable=...`` suppression with no reason
+          string (the suppression policy: every waiver says why)
+========  ==========================================================
+
+Suppression syntax (same line as the finding)::
+
+    x = batch["n"].item()  # shardlint: disable=TPU001 -- probe path, once
+
+The reachability analysis is name-based and project-local: defs named
+``train_step``/``eval_step``, functions passed to tracing transforms
+(``jit``/``grad``/``scan``/``shard_map``/``pallas_call``/...), and
+functions decorated with them seed the traced set; the set closes over
+same-named project defs called from traced bodies, and lexically nested
+defs. Deliberately over-approximate — a false "traced" marking surfaces
+at lint time and is cheap to inspect; a missed one ships a sync.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+RULES = {
+    "TPU000": "suppression lacks a reason string",
+    "TPU001": "host-device sync in the hot path",
+    "TPU002": "PartitionSpec axis not in the mesh-axis vocabulary",
+    "TPU003": "jitted step-like function without donate_argnums",
+    "TPU004": "impure call in traced code",
+    "TPU005": "host-data jnp.array inside a traced function",
+}
+
+# tracing transforms: a function passed to (or decorated with) one of
+# these runs under trace — host syncs and impurity inside are bugs
+TRACE_TRANSFORMS = frozenset({
+    "jit", "pjit", "grad", "value_and_grad", "vmap", "pmap", "scan",
+    "while_loop", "fori_loop", "cond", "switch", "checkpoint", "remat",
+    "shard_map", "pallas_call", "custom_vjp", "custom_jvp", "associative_scan",
+})
+
+STEP_FN_NAMES = frozenset({"train_step", "eval_step"})
+
+# host-sync callables by resolved dotted path (module aliases resolved)
+HOST_SYNC_PATHS = frozenset({
+    "jax.device_get", "jax.block_until_ready",
+    "numpy.asarray", "numpy.array",
+})
+
+IMPURE_PATHS = frozenset({
+    "time.time", "time.perf_counter", "time.monotonic",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+})
+IMPURE_PREFIXES = ("numpy.random.", "random.")
+
+# params that, by this repo's naming convention, carry array data —
+# float()/int() of these inside traced code concretizes a tracer.
+# Deliberately an allowlist: traced helpers legitimately int() their
+# static Python config args (microbatch counts, capacities, seq lens),
+# and a blocklist of "static-looking" names cannot keep up with them.
+_ARRAY_PARAM_NAMES = frozenset({
+    "state", "batch", "params", "grads", "grad", "x", "y", "q", "k", "v",
+    "logits", "loss", "inputs", "targets", "weights", "m", "metrics",
+    "nll", "w", "out", "lora", "micro", "carry", "acc", "hidden",
+})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*shardlint:\s*disable=([A-Z0-9, ]+?)\s*(?:--\s*(\S.*))?$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.code} " \
+               f"{self.message}"
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis vocabulary: parsed from parallel/mesh.py, never hardcoded —
+# adding a mesh axis must not require touching the linter
+# ---------------------------------------------------------------------------
+
+def mesh_axis_vocabulary(mesh_py_source: str) -> Set[str]:
+    """The axis names MESH_AXES declares, resolving AXIS_* constants."""
+    tree = ast.parse(mesh_py_source)
+    consts: Dict[str, str] = {}
+    vocab: Set[str] = set()
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        target = node.targets[0].id
+        if isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            consts[target] = node.value.value
+        elif target == "MESH_AXES" and isinstance(node.value, ast.Tuple):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    vocab.add(elt.value)
+                elif isinstance(elt, ast.Name) and elt.id in consts:
+                    vocab.add(consts[elt.id])
+    if not vocab:
+        raise ValueError("could not parse MESH_AXES out of parallel/mesh.py "
+                         "— the TPU002 vocabulary would be empty")
+    return vocab
+
+
+def default_mesh_vocabulary() -> Set[str]:
+    mesh_py = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "parallel", "mesh.py")
+    with open(mesh_py) as f:
+        return mesh_axis_vocabulary(f.read())
+
+
+# ---------------------------------------------------------------------------
+# per-module model: imports, function defs, call names
+# ---------------------------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[List[str]]:
+    """Attribute/Name chain → ["np", "random", "normal"]; None if the
+    root is a call/subscript (dynamic, unresolvable)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class _Module:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        # local alias -> dotted module/object path
+        self.imports: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        # every def, with its lexical parent def (None at module level)
+        self.defs: List[ast.FunctionDef] = []
+        self.parent: Dict[ast.AST, Optional[ast.AST]] = {}
+        self._collect_defs(self.tree, None)
+        # suppressions: line -> (codes, reason|None)
+        self.suppressions: Dict[int, Tuple[Set[str], Optional[str]]] = {}
+        for i, raw in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(raw)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                self.suppressions[i] = (codes, m.group(2))
+
+    def _collect_defs(self, node: ast.AST, parent_def) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.append(child)
+                self.parent[child] = parent_def
+                self._collect_defs(child, child)
+            else:
+                self._collect_defs(child, parent_def)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Call target → dotted path with the root alias resolved
+        ("np.random.normal" → "numpy.random.normal")."""
+        parts = _dotted(node)
+        if not parts:
+            return None
+        root = self.imports.get(parts[0], parts[0])
+        return ".".join([root] + parts[1:])
+
+    def terminal_name(self, node: ast.AST) -> Optional[str]:
+        parts = _dotted(node)
+        return parts[-1] if parts else None
+
+
+# ---------------------------------------------------------------------------
+# traced-set computation (project-wide)
+# ---------------------------------------------------------------------------
+
+def _fn_args(fn) -> List[str]:
+    a = fn.args
+    names = [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _is_trace_transform(mod: _Module, call: ast.Call) -> bool:
+    name = mod.terminal_name(call.func)
+    return name in TRACE_TRANSFORMS
+
+
+def _compute_traced(modules: List[_Module]) -> Dict[int, bool]:
+    """id(def-node) -> traced, closed over name-matched project calls."""
+    by_name: Dict[str, List[Tuple[_Module, ast.AST]]] = {}
+    for mod in modules:
+        for fn in mod.defs:
+            by_name.setdefault(fn.name, []).append((mod, fn))
+
+    traced: Set[int] = set()
+
+    def mark(fn) -> bool:
+        if id(fn) in traced:
+            return False
+        traced.add(id(fn))
+        return True
+
+    # seeds: step-named defs, transform operands, transform decorators
+    for mod in modules:
+        for fn in mod.defs:
+            if fn.name in STEP_FN_NAMES:
+                mark(fn)
+            for dec in fn.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if mod.terminal_name(d) in TRACE_TRANSFORMS:
+                    mark(fn)
+        local_defs = {f.name: f for f in mod.defs}
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_trace_transform(mod, node)):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name) and arg.id in local_defs:
+                    mark(local_defs[arg.id])
+                elif isinstance(arg, ast.Lambda):
+                    # lambdas are handled positionally during rule
+                    # visits (they have no def entry); nothing to mark
+                    pass
+
+    # closure: calls from traced bodies pull in same-named defs; nested
+    # defs inherit the enclosing fn's tracedness
+    changed = True
+    while changed:
+        changed = False
+        for mod in modules:
+            for fn in mod.defs:
+                parent = mod.parent.get(fn)
+                if parent is not None and id(parent) in traced \
+                        and id(fn) not in traced:
+                    traced.add(id(fn))
+                    changed = True
+                if id(fn) not in traced:
+                    continue
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = mod.terminal_name(node.func)
+                    for m2, f2 in by_name.get(callee, ()):
+                        if id(f2) not in traced:
+                            traced.add(id(f2))
+                            changed = True
+    return {i: True for i in traced}
+
+
+# ---------------------------------------------------------------------------
+# rule visitors
+# ---------------------------------------------------------------------------
+
+def _subtree_names(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _lint_module(mod: _Module, traced: Dict[int, bool],
+                 vocab: Set[str]) -> List[Finding]:
+    raw: List[Finding] = []
+
+    def add(node, code, message):
+        raw.append(Finding(mod.path, node.lineno, node.col_offset,
+                           code, message))
+
+    # which defs (by containment) each node sits in
+    enclosing: Dict[int, List[ast.AST]] = {}
+
+    def fill(node, stack):
+        for child in ast.iter_child_nodes(node):
+            is_def = isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+            enclosing[id(child)] = stack
+            fill(child, stack + [child] if is_def else stack)
+
+    fill(mod.tree, [])
+
+    def in_traced(node) -> Optional[ast.AST]:
+        for fn in reversed(enclosing.get(id(node), [])):
+            if traced.get(id(fn)):
+                return fn
+        return None
+
+    # PartitionSpec binding names in this module (TPU002 applies only
+    # to names actually bound to jax's PartitionSpec)
+    pspec_names = {alias for alias, target in mod.imports.items()
+                   if target.endswith(".PartitionSpec")}
+
+    # loop/comprehension targets in scope of a node (for the
+    # per-element device_get rule)
+    loop_vars: Dict[int, Set[str]] = {}
+
+    def fill_loops(node, vars_):
+        for child in ast.iter_child_nodes(node):
+            v = vars_
+            if isinstance(child, ast.For):
+                v = vars_ | _subtree_names(child.target)
+            elif isinstance(child, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                    ast.GeneratorExp)):
+                v = set(vars_)
+                for gen in child.generators:
+                    v |= _subtree_names(gen.target)
+            loop_vars[id(child)] = v
+            fill_loops(child, v)
+
+    fill_loops(mod.tree, set())
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        path = mod.resolve(node.func)
+        fn = in_traced(node)
+
+        # ---- TPU001: per-element device_get in a loop (anywhere) ----
+        if path == "jax.device_get" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) \
+                    and arg.id in loop_vars.get(id(node), set()):
+                add(node, "TPU001",
+                    "per-element jax.device_get inside a loop/"
+                    "comprehension — one host round-trip per element; "
+                    "batch into a single jax.device_get of the whole "
+                    "tree, then index on the host")
+
+        if fn is not None:
+            # ---- TPU001: host sync inside traced code ----
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                add(node, "TPU001",
+                    ".item() inside a traced function blocks on the "
+                    "device — keep metrics device-resident and fetch "
+                    "once outside the step")
+            elif path in HOST_SYNC_PATHS:
+                add(node, "TPU001",
+                    f"{path} inside a traced function forces a "
+                    "host-device sync (or fails to trace at all) — "
+                    "hoist it out of the jit-reachable region")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in ("float", "int") and node.args:
+                arg_names = _subtree_names(node.args[0])
+                params = set(_fn_args(fn)) & _ARRAY_PARAM_NAMES
+                if arg_names & params:
+                    add(node, "TPU001",
+                        f"{node.func.id}() of traced array data "
+                        "concretizes the tracer (host sync / trace "
+                        "error) — use jnp ops, or fetch on the host "
+                        "after the step")
+
+            # ---- TPU004: impurity inside traced code ----
+            if path is not None and (
+                    path in IMPURE_PATHS
+                    or path.startswith(IMPURE_PREFIXES)):
+                add(node, "TPU004",
+                    f"{path} inside a traced function is baked in as "
+                    "a compile-time constant (and retraces never "
+                    "refresh it) — thread jax.random keys / step "
+                    "counters through the function args")
+
+            # ---- TPU005: host-data jnp.array in traced code ----
+            if path in ("jax.numpy.array", "jax.numpy.asarray") \
+                    and node.args:
+                arg = node.args[0]
+                host_literal = isinstance(arg, (ast.List, ast.Tuple,
+                                                ast.Dict))
+                np_call = (isinstance(arg, ast.Call)
+                           and (mod.resolve(arg.func) or "")
+                           .startswith("numpy."))
+                if host_literal or np_call:
+                    add(node, "TPU005",
+                        "jnp.array of Python/host data inside a traced "
+                        "function: a hidden host→device transfer staged "
+                        "at every trace, constant-folded into the "
+                        "program — build it once outside the jit and "
+                        "close over (or pass) the device array")
+
+        # ---- TPU002: PartitionSpec axis vocabulary ----
+        term = mod.terminal_name(node.func)
+        if (term in pspec_names or (path or "").endswith(".PartitionSpec")):
+            def check_axis(e):
+                if isinstance(e, ast.Constant) \
+                        and isinstance(e.value, str) \
+                        and e.value not in vocab:
+                    add(e, "TPU002",
+                        f"PartitionSpec names axis {e.value!r} but the "
+                        f"mesh vocabulary (parallel/mesh.py MESH_AXES) "
+                        f"is {sorted(vocab)} — an unknown axis silently "
+                        "REPLICATES the dimension")
+                elif isinstance(e, (ast.Tuple, ast.List)):
+                    for sub in e.elts:
+                        check_axis(sub)
+            for a in node.args:
+                check_axis(a)
+
+        # ---- TPU003: step-like jit without donation ----
+        if term in ("jit", "pjit") and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                local = {f.name: f for f in mod.defs}
+                tfn = local.get(target.id)
+                if tfn is not None and _is_step_like(tfn) and not any(
+                        kw.arg in ("donate_argnums", "donate_argnames")
+                        for kw in node.keywords):
+                    add(node, "TPU003",
+                        f"jit of step-like {target.id!r} (takes and "
+                        "returns a state pytree) without donate_argnums "
+                        "— the old params+optimizer buffers stay live "
+                        "across the update, doubling peak HBM")
+
+    # decorator form of TPU003: @jax.jit (bare) on a step-like def
+    for fn in mod.defs:
+        if not _is_step_like(fn):
+            continue
+        for dec in fn.decorator_list:
+            d = dec.func if isinstance(dec, ast.Call) else dec
+            if mod.terminal_name(d) in ("jit", "pjit"):
+                kws = dec.keywords if isinstance(dec, ast.Call) else []
+                if not any(kw.arg in ("donate_argnums", "donate_argnames")
+                           for kw in kws):
+                    raw.append(Finding(
+                        mod.path, fn.lineno, fn.col_offset, "TPU003",
+                        f"jitted step-like {fn.name!r} without "
+                        "donate_argnums — the old params+optimizer "
+                        "buffers stay live across the update, doubling "
+                        "peak HBM"))
+
+    # ---- suppression accounting ----
+    out: List[Finding] = []
+    reasonless_reported: Set[int] = set()
+    for f in raw:
+        sup = mod.suppressions.get(f.line)
+        if sup and f.code in sup[0]:
+            if sup[1]:
+                continue  # suppressed, with a reason — honored
+            if f.line not in reasonless_reported:
+                reasonless_reported.add(f.line)
+                out.append(Finding(
+                    mod.path, f.line, 0, "TPU000",
+                    "suppression lacks a reason string — write "
+                    "'# shardlint: disable=CODE -- why it is safe'"))
+            continue
+        out.append(f)
+    # a reasonless suppression is a finding even when nothing fired on
+    # the line (it would silently swallow future findings)
+    for line, (codes, reason) in mod.suppressions.items():
+        if not reason and line not in reasonless_reported:
+            out.append(Finding(
+                mod.path, line, 0, "TPU000",
+                "suppression lacks a reason string — write "
+                "'# shardlint: disable=CODE -- why it is safe'"))
+    return out
+
+
+def _is_step_like(fn) -> bool:
+    """Takes a state pytree (first arg named *state*) and RETURNS one —
+    a returned value (or top-level tuple element) that is a *state name
+    or a *State(...) constructor. Top-level only: an eval step that
+    merely PASSES state into a loss call returns scalars, not a state,
+    and needs no donation."""
+    args = _fn_args(fn)
+    if not args or "state" not in args[0]:
+        return False
+
+    def is_statey(e) -> bool:
+        if isinstance(e, ast.Name) and "state" in e.id:
+            return True
+        if isinstance(e, ast.Call):
+            parts = _dotted(e.func)
+            return bool(parts and "State" in parts[-1])
+        return False
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        cands = (list(node.value.elts)
+                 if isinstance(node.value, ast.Tuple) else [node.value])
+        if any(is_statey(c) for c in cands):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def lint_sources(sources: Dict[str, str],
+                 vocab: Optional[Set[str]] = None) -> List[Finding]:
+    """Project-wide lint over {path: source}. The traced set is closed
+    over ALL given sources, so cross-module reachability works."""
+    if vocab is None:
+        vocab = default_mesh_vocabulary()
+    modules = []
+    findings: List[Finding] = []
+    for path, src in sorted(sources.items()):
+        try:
+            modules.append(_Module(path, src))
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 0, 0, "TPU000",
+                                    f"unparseable: {e.msg}"))
+    traced = _compute_traced(modules)
+    for mod in modules:
+        findings.extend(_lint_module(mod, traced, vocab))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col))
+
+
+def lint_source(source: str, path: str = "<string>",
+                vocab: Optional[Set[str]] = None) -> List[Finding]:
+    return lint_sources({path: source}, vocab=vocab)
+
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f) for f in filenames
+                           if f.endswith(".py"))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(out)
+
+
+def lint_paths(paths: Iterable[str],
+               vocab: Optional[Set[str]] = None) -> List[Finding]:
+    sources = {}
+    for f in iter_py_files(paths):
+        with open(f) as fh:
+            sources[f] = fh.read()
+    return lint_sources(sources, vocab=vocab)

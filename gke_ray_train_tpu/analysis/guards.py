@@ -1,0 +1,358 @@
+"""shardlint level 3 — opt-in runtime teeth for the linted properties.
+
+Three guards, each enabled by an env/config knob (audited in
+``config.py`` KNOWN_KEYS, forwarded to Ray workers by the trainer):
+
+- ``TRANSFER_GUARD=disallow|log`` — wraps the hot loop in JAX's
+  device→host transfer guard so an implicit fetch (a stray ``.item()``,
+  ``np.asarray`` on a device array) raises/logs AT THE CALL SITE
+  instead of silently serializing the step pipeline. The loop's
+  legitimate fetches (the once-per-log-step batched metrics fetch, the
+  checkpoint save, eval) run inside :func:`allow_transfers` — the
+  explicit allow-list the ISSUE's policy demands. No-op on the CPU
+  backend (host "transfers" are zero-copy there; the knob still
+  exercises the config plumbing in CI).
+
+- ``RECOMPILE_LIMIT=N`` — the trace-level recompile *detector*
+  (jaxprcheck.py) turned into a hard error: more than N compiles of any
+  one function raises :class:`RecompileLimitExceeded` from inside the
+  compile path, naming the function and the signature churn that caused
+  it. Catches shape/dtype/sharding churn the moment it happens instead
+  of as a mysteriously slow run.
+
+- ``DIVERGENCE_GUARD=1`` — multi-host lowered-HLO agreement: before the
+  first step each host fingerprints its lowered step-fn StableHLO and
+  allgathers the digest. Hosts tracing DIFFERENT programs (data-
+  dependent Python branching, version skew, divergent config) today
+  present as an unexplained collective deadlock the PR-3 watchdog can
+  only name; the guard fails fast with the per-host diff instead.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class GuardViolation(RuntimeError):
+    """Base class for runtime-guard failures."""
+
+
+class RecompileLimitExceeded(GuardViolation):
+    """One function was compiled more often than RECOMPILE_LIMIT allows."""
+
+
+class HloDivergenceError(GuardViolation):
+    """Hosts lowered DIFFERENT step programs — collectives would wedge."""
+
+
+def _knob(name: str, config: Optional[dict] = None) -> Optional[str]:
+    """Config key wins over env (same precedence as every other knob)."""
+    if config is not None and name in config:
+        return str(config[name])
+    return os.environ.get(name)
+
+
+# ---------------------------------------------------------------------------
+# transfer guard
+# ---------------------------------------------------------------------------
+
+def transfer_guard_mode(config: Optional[dict] = None) -> Optional[str]:
+    raw = (_knob("TRANSFER_GUARD", config) or "").strip().lower()
+    if raw in ("", "0", "off", "false", "allow"):
+        return None
+    if raw in ("log", "disallow"):
+        return raw
+    logger.warning("TRANSFER_GUARD=%r not recognized "
+                   "(expected log|disallow|off); guard disabled", raw)
+    return None
+
+
+def transfer_guard_ctx(mode: Optional[str]):
+    """Context manager enforcing ``mode`` on device→host transfers for
+    the current thread. Only d2h is guarded: the input pipeline's
+    host→device placement is the loop's own legitimate traffic."""
+    if mode is None:
+        return contextlib.nullcontext()
+    import jax
+    return jax.transfer_guard_device_to_host(mode)
+
+
+def allow_transfers():
+    """The explicit allow-list: wrap the loop's sanctioned fetch sites
+    (batched metrics fetch, eval, checkpoint save, host collectives)."""
+    import jax
+    return jax.transfer_guard_device_to_host("allow")
+
+
+# ---------------------------------------------------------------------------
+# recompile limit (hard-error form of jaxprcheck.RecompileDetector)
+# ---------------------------------------------------------------------------
+
+_LIMIT_STATE: Dict[str, Any] = {"detector": None, "limit": 0}
+
+
+def recompile_limit(config: Optional[dict] = None) -> int:
+    raw = _knob("RECOMPILE_LIMIT", config)
+    try:
+        return max(int(raw), 0) if raw else 0
+    except ValueError:
+        logger.warning("RECOMPILE_LIMIT=%r is not an int; guard disabled",
+                       raw)
+        return 0
+
+
+def install_recompile_limit(limit: Optional[int] = None,
+                            config: Optional[dict] = None) -> bool:
+    """Arm the hard limit: the (limit+1)-th compile of any single
+    function raises :class:`RecompileLimitExceeded` from the compile
+    path, carrying the signature diff. Returns True when armed."""
+    limit = recompile_limit(config) if limit is None else limit
+    if limit <= 0:
+        return False
+    from gke_ray_train_tpu.analysis.jaxprcheck import RecompileDetector
+
+    def on_excess(name, sigs):
+        raise RecompileLimitExceeded(
+            f"function {name!r} compiled {len(sigs)} times "
+            f"(RECOMPILE_LIMIT={limit}). A step fn must compile once — "
+            "look for shape/dtype/sharding churn in its inputs:\n"
+            + RecompileDetector.describe_churn(sigs))
+
+    uninstall_recompile_limit()
+    det = RecompileDetector(on_compile_over=on_excess, over_count=limit)
+    det.start()
+    _LIMIT_STATE.update(detector=det, limit=limit)
+    logger.info("recompile limit armed: hard error past %d compiles "
+                "of any one function", limit)
+    return True
+
+
+def uninstall_recompile_limit() -> None:
+    det = _LIMIT_STATE.get("detector")
+    if det is not None:
+        det.stop()
+    _LIMIT_STATE.update(detector=None, limit=0)
+
+
+# ---------------------------------------------------------------------------
+# multi-host divergence guard
+# ---------------------------------------------------------------------------
+
+def divergence_guard_enabled(config: Optional[dict] = None) -> bool:
+    raw = (_knob("DIVERGENCE_GUARD", config) or "").strip().lower()
+    return raw not in ("", "0", "off", "false")
+
+# StableHLO text capped per host for the post-mismatch diff exchange:
+# digests (64 hex chars) establish DISAGREEMENT cheaply; the capped
+# text is only shipped once a mismatch is already certain
+_DIFF_TEXT_CAP = 64 * 1024
+_BARRIER_TIMEOUT_MS = 120_000
+# per-process round counter: the guard is collective (every host calls
+# it in lockstep), so the sequence numbers — and therefore the KV keys
+# — agree across hosts without any coordination
+_ROUND = [0]
+
+
+def hlo_fingerprint(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def _distributed_client():
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:  # noqa: BLE001 - private API drift
+        return None
+
+
+def _allgather_str(value: str, tag: str, n_procs: int, rank: int) -> list:
+    """Exchange one string per host over the jax.distributed KV store.
+
+    Deliberately NOT an XLA collective: the guard must work on the CPU
+    multi-process harness (whose backend has no cross-process XLA
+    collectives) and, more importantly, must stay usable exactly when
+    device collectives are the thing about to deadlock — the KV
+    store/barrier is the same control-plane rendezvous
+    ``jax.distributed.initialize`` already stood up."""
+    import base64
+    client = _distributed_client()
+    if client is None:
+        raise RuntimeError("jax.distributed client unavailable")
+    client.key_value_set(f"{tag}/{rank}",
+                         base64.b64encode(value.encode()).decode())
+    client.wait_at_barrier(f"{tag}/barrier", _BARRIER_TIMEOUT_MS)
+    return [
+        base64.b64decode(
+            client.blocking_key_value_get(f"{tag}/{r}",
+                                          _BARRIER_TIMEOUT_MS)).decode()
+        for r in range(n_procs)]
+
+
+def check_host_hlo_agreement(step_fn, *abstract_args,
+                             label: str = "train_step") -> Optional[str]:
+    """Exchange a fingerprint of this host's lowered step-fn HLO and
+    fail fast — with the per-host diff — when hosts disagree.
+
+    ``step_fn`` needs a ``.lower`` (a jitted function or the AOT
+    GuardedStep passthrough); args may be concrete or abstract. Returns
+    the agreed fingerprint (None when lowering or the distributed
+    client is unavailable — an opt-in guard fails open, loudly, rather
+    than killing a run it cannot check).
+    """
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    n_procs, rank = jax.process_count(), jax.process_index()
+
+    def exec_text():
+        # AOT fast path: a GuardedStep already holds a compiled
+        # executable — re-texting it is free, where .lower() would
+        # re-TRACE the whole step (jit's AOT lower never populates the
+        # dispatch cache, so that trace would be duplicated by the
+        # first real step call; restart_to_first_step_s money at 8B)
+        compiled = getattr(step_fn, "_compiled", None)
+        if compiled is None:
+            return None
+        try:
+            return compiled.as_text()
+        except Exception:  # noqa: BLE001 - some backends cannot re-text
+            return None
+
+    def mlir_text():
+        lower = getattr(step_fn, "lower", None)
+        if lower is None:
+            return None
+        try:
+            # one extra trace+MLIR-lowering at attempt start (no XLA
+            # compile) — the opt-in cost of the check on the jit path
+            return lower(*abstract_args).as_text()
+        except Exception as e:  # noqa: BLE001 - guard must not kill run
+            logger.warning("divergence guard: lowering failed (%s: %s)",
+                           type(e).__name__, e)
+            return None
+
+    text = exec_text()
+    fmt = "exec" if text is not None else "mlir"
+    if text is None:
+        text = mlir_text()
+
+    _ROUND[0] += 1
+    tag = f"shardlint/divergence/{_ROUND[0]}"
+
+    def exchange(sub, fmt, text):
+        """One (format, digest) exchange round; returns (fmts, digests).
+        A host that could not produce text sends a sentinel — the
+        gathered view is identical everywhere, so every host reaches
+        the same skip/compare/recompute verdict in lockstep."""
+        payload = f"{fmt}\n{hlo_fingerprint(text) if text else ''}"
+        rows = _allgather_str(payload, f"{tag}/{sub}", n_procs, rank)
+        fmts, digests = zip(*(r.split("\n", 1) for r in rows))
+        return list(fmts), list(digests)
+
+    try:
+        fmts, digests = exchange("digest", fmt if text else "none", text)
+        if "none" in fmts:
+            logger.warning("divergence guard: host(s) %s could not "
+                           "produce program text; check skipped",
+                           [i for i, f in enumerate(fmts) if f == "none"])
+            return None
+        if len(set(fmts)) > 1:
+            # hosts derived their text DIFFERENTLY (one re-texted its
+            # AOT executable, another lowered fresh) — the digests are
+            # incomparable across formats and must not be read as
+            # divergence. Every host falls back to the one universally
+            # derivable format (lowered MLIR) and compares again.
+            logger.info("divergence guard: mixed text sources %s; "
+                        "re-deriving via lower() on every host",
+                        sorted(set(fmts)))
+            if fmt != "mlir":
+                text = mlir_text()
+            fmts, digests = exchange("digest2", "mlir" if text else "none",
+                                     text)
+            if "none" in fmts:
+                logger.warning("divergence guard: lowering unavailable "
+                               "on host(s) %s; check skipped",
+                               [i for i, f in enumerate(fmts)
+                                if f == "none"])
+                return None
+    except Exception as e:  # noqa: BLE001 - control-plane hiccup
+        logger.warning("divergence guard: fingerprint exchange failed "
+                       "(%s: %s); skipped", type(e).__name__, e)
+        return None
+    if len(set(digests)) == 1:
+        logger.info("divergence guard: %d hosts agree on %s HLO %s",
+                    n_procs, label, digests[0][:12])
+        return digests[0]
+    # disagreement is certain — every host ships capped text for a
+    # real per-host diff (all hosts computed the same verdict, so the
+    # second exchange is symmetric)
+    import difflib
+    per_host = ", ".join(f"host {i}: {d[:12]}"
+                         for i, d in enumerate(digests))
+    try:
+        texts = _allgather_str(text[:_DIFF_TEXT_CAP], f"{tag}/text",
+                               n_procs, rank)
+    except Exception as e:  # noqa: BLE001 - the VERDICT must survive a
+        # control-plane failure here (a diverged peer may already be
+        # dying): raise the nonretryable divergence error with the
+        # fingerprints, not a retryable generic that buries them
+        raise HloDivergenceError(
+            f"hosts lowered DIFFERENT {label} programs — the "
+            f"collectives they emit will deadlock, not train. "
+            f"Fingerprints: {per_host}. (per-host diff unavailable: "
+            f"text exchange failed with {type(e).__name__}: {e})")
+    # diff OWN program against the first DISAGREEING peer — diffing
+    # against host 0 unconditionally hands host 0 (and every host that
+    # agrees with it) an empty diff about its own program
+    peer = next((i for i in range(n_procs)
+                 if digests[i] != digests[rank]), None)
+    diff = [] if peer is None else list(difflib.unified_diff(
+        texts[rank].splitlines(), texts[peer].splitlines(),
+        lineterm="", fromfile=f"host {rank} (this host)",
+        tofile=f"host {peer}"))[:40]
+    raise HloDivergenceError(
+        f"hosts lowered DIFFERENT {label} programs — the collectives "
+        f"they emit will deadlock, not train. Fingerprints: {per_host}.\n"
+        "Likely causes: data-dependent Python branching in the step, "
+        "per-host config drift, or jax/jaxlib version skew.\n"
+        + ("\n".join(diff) if diff
+           else "(programs differ beyond the diff cap)"))
+
+
+# ---------------------------------------------------------------------------
+# the bundle run_training consumes
+# ---------------------------------------------------------------------------
+
+class RuntimeGuards:
+    """Resolved guard configuration for one training run."""
+
+    def __init__(self, *, transfer_mode: Optional[str] = None,
+                 divergence: bool = False):
+        self.transfer_mode = transfer_mode
+        self.divergence = divergence
+
+    @staticmethod
+    def from_config(config: Optional[dict] = None) -> "RuntimeGuards":
+        """Env/config resolution (config key wins). Also the from-env
+        default ``run_training`` builds when handed no guards."""
+        return RuntimeGuards(
+            transfer_mode=transfer_guard_mode(config),
+            divergence=divergence_guard_enabled(config))
+
+    def transfer_ctx(self):
+        return transfer_guard_ctx(self.transfer_mode)
+
+    def check_divergence(self, step_fn, state, batch,
+                         label: str = "train_step") -> None:
+        if self.divergence:
+            check_host_hlo_agreement(step_fn, state, batch, label=label)
+
+    def __repr__(self) -> str:  # pragma: no cover - logging nicety
+        return (f"RuntimeGuards(transfer={self.transfer_mode or 'off'}, "
+                f"divergence={'on' if self.divergence else 'off'})")

@@ -52,6 +52,10 @@ KNOWN_KEYS = frozenset({
     # shared storage, and the AOT train-step executable persisted
     # beside the checkpoint (1/default = on)
     "COMPILE_CACHE_DIR", "AOT_TRAIN_STEP",
+    # shardlint runtime guards (analysis/guards.py): d2h transfer guard
+    # around the hot loop (log|disallow), hard compile-count limit per
+    # step fn, multi-host lowered-HLO divergence check at attempt start
+    "TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
     # inference comparison
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",

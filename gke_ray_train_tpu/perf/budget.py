@@ -187,13 +187,19 @@ PRESETS = {
 
 
 def build_preset_step(preset: Union[str, Preset], *, remat=None,
-                      wrap=None):
+                      wrap=None, donate: bool = False,
+                      with_jitted: bool = False):
     """(compiled, state, batch) for a preset on the current devices —
     the deterministic compile whose report the budget pins.
 
     ``wrap(unjitted_step) -> fn``: transform the step before jit — the
     regression tests use it to deliberately smuggle an extra collective
-    into the grad path and prove the comparator catches it."""
+    into the grad path and prove the comparator catches it.
+    ``donate``: budgets stay donate=False (backend-independent); the
+    analysis CLI's donation check builds the donated twin.
+    ``with_jitted``: return (compiled, state, batch, jitted_step) — the
+    analysis compile-once check dispatches the JITTED fn (the compiled
+    executable can trivially never recompile)."""
     import jax
     import jax.numpy as jnp
 
@@ -210,8 +216,10 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
                remat=p.remat if remat is None else remat)
     opt = make_optimizer(1e-3)
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
-    # donate=False: budgets must not vary with backend donation support
-    step = make_train_step(cfg, opt, mesh=mesh, donate=False)
+    # donate=False default: budgets must not vary with backend donation
+    # support (the analysis donation check opts in explicitly)
+    step = make_train_step(cfg, opt, mesh=mesh, donate=donate,
+                           donate_batch=False)
     if wrap is not None:
         step = jax.jit(wrap(step.__wrapped__))
     batch = jax.device_put(
@@ -220,6 +228,8 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
          "weights": jnp.ones((p.batch, p.seq), jnp.float32)},
         batch_shardings(mesh))
     compiled = step.lower(state, batch).compile()
+    if with_jitted:
+        return compiled, state, batch, step
     return compiled, state, batch
 
 

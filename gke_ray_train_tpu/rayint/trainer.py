@@ -66,7 +66,13 @@ DEFAULT_COORDINATOR_PORT = 8476  # fallback when port discovery fails
 NONRETRYABLE_TYPES = (KeyError, ValueError, TypeError, AttributeError,
                       ImportError, NotImplementedError)
 _NONRETRYABLE_NAMES = frozenset(t.__name__ for t in NONRETRYABLE_TYPES) | {
-    "ModuleNotFoundError"}
+    "ModuleNotFoundError",
+    # shardlint runtime-guard violations (analysis/guards.py) are
+    # deterministic by construction — divergent traces and shape-churn
+    # recompiles replay identically every attempt, and the guards'
+    # contract is FAIL FAST with the diagnosis on top, not buried
+    # under max_failures retries
+    "GuardViolation", "HloDivergenceError", "RecompileLimitExceeded"}
 # explicitly-retryable markers override the type match: a collective
 # checkpoint-restore failure is often a ValueError underneath
 # (orbax/tensorstore), but a fresh attempt re-reads storage
@@ -183,6 +189,8 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     payload because on the Ray path the worker context lives in another
     process and the driver could not read it otherwise."""
     os.environ.update(env)
+    from gke_ray_train_tpu.analysis.guards import (
+        install_recompile_limit, uninstall_recompile_limit)
     from gke_ray_train_tpu.perf.cache import (
         enable_persistent_cache, log_cache_summary)
     from gke_ray_train_tpu.rayint.context import get_context
@@ -199,6 +207,13 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     preempt.reset()              # a retry must not inherit the previous
     preempt.install()            # attempt's preemption flag
     try:
+        # RECOMPILE_LIMIT teeth (analysis/guards.py): armed per attempt
+        # so the count starts fresh on every retry — shape/dtype/
+        # sharding churn past the limit raises from the compile path,
+        # naming the function and the signature diff. Armed INSIDE the
+        # try: the finally below must disarm it on every failure path,
+        # or a raising log handler outlives the attempt
+        install_recompile_limit(config=config)
         ret = fn(config)
         reported = ctx.last_reported
         return {"metrics": ret if ret is not None else (reported or {}),
@@ -210,6 +225,7 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         # a finished (or failed — its error surfaces via the future)
         # worker must never be reported as stalled
         ctx.heartbeat_done()
+        uninstall_recompile_limit()
         # restore the default SIGTERM disposition: outside an attempt
         # nothing reads the preemption flag, and a long-lived driver
         # process must not silently swallow termination
@@ -384,13 +400,15 @@ class JaxTrainer:
                 "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
                 "NUM_PROCESSES": str(n),
             }
-            # compile-cache knobs ride to the workers explicitly — a
-            # driver-side `env COMPILE_CACHE_DIR=...` must shape the
-            # workers' cache even without a Ray runtime-env entry
+            # compile-cache + runtime-guard knobs ride to the workers
+            # explicitly — a driver-side `env COMPILE_CACHE_DIR=...` or
+            # `env TRANSFER_GUARD=disallow` must shape the workers even
+            # without a Ray runtime-env entry
             env_base.update({
                 k: os.environ[k]
                 for k in ("COMPILE_CACHE_DIR", "COMPILE_CACHE",
-                          "AOT_TRAIN_STEP")
+                          "AOT_TRAIN_STEP", "TRANSFER_GUARD",
+                          "RECOMPILE_LIMIT", "DIVERGENCE_GUARD")
                 if k in os.environ})
             futures = [
                 w.run.remote(self.fn, self.config,

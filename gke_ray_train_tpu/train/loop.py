@@ -17,6 +17,7 @@ context (:296-310). Differences by design:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import time
@@ -25,12 +26,24 @@ from typing import Callable, Iterable, Optional
 import jax
 import numpy as np
 
+from gke_ray_train_tpu.analysis.guards import RuntimeGuards, allow_transfers
 from gke_ray_train_tpu.data.prefetch import make_batch_source
 from gke_ray_train_tpu.train import preempt
 from gke_ray_train_tpu.train.metrics import ThroughputMeter, paused
 from gke_ray_train_tpu.train.step import TrainState
 
 logger = logging.getLogger(__name__)
+
+
+def _fetch_metrics(m: dict) -> dict:
+    """ONE batched host sync for the whole metrics tree.
+
+    The pre-shardlint form — ``float(jax.device_get(v))`` per key —
+    paid one device round-trip per metric every log step (TPU001);
+    ``jax.device_get`` on the dict transfers every leaf in a single
+    fetch, inside the transfer guard's explicit allow-list."""
+    with allow_transfers():
+        return {k: float(v) for k, v in jax.device_get(m).items()}
 
 
 def run_training(state: TrainState,
@@ -54,6 +67,7 @@ def run_training(state: TrainState,
                  tb_writer=None,
                  heartbeat_fn: Optional[Callable] = None,
                  fault_injector=None,
+                 guards: Optional[RuntimeGuards] = None,
                  is_host0: bool = True) -> tuple:
     """Returns (final_state, last_metrics).
 
@@ -91,6 +105,15 @@ def run_training(state: TrainState,
     fault_injector: deterministic fault hook fired once per completed
     step (testing/faults.py). None = built from $FAULT_SPEC, which is
     unset in production — the env read is the only overhead.
+    guards: runtime enforcement of the shardlint properties
+    (analysis/guards.py). None = resolved from env: TRANSFER_GUARD
+    wraps the hot loop in jax's device→host transfer guard (the
+    batched metrics fetch, eval, and checkpoint saves are the
+    explicit allow-list); DIVERGENCE_GUARD allgathers a fingerprint
+    of each host's lowered step-fn HLO before the first step and
+    fails fast — with the per-host diff — when hosts traced
+    different programs (otherwise that bug presents as a collective
+    deadlock the watchdog can only name).
 
     Preemption (train/preempt.py): when the SIGTERM flag is up at a
     step boundary the loop force-saves a checkpoint, waits until it is
@@ -105,6 +128,8 @@ def run_training(state: TrainState,
     # is a deserialized AOT executable; perf/cache.py)
     t_loop0 = time.perf_counter()
     loop_timing: dict = {}
+    if guards is None:
+        guards = RuntimeGuards.from_config()
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
     load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
     if fault_injector is None:
@@ -170,8 +195,11 @@ def run_training(state: TrainState,
             # up — exits happen only where every rank runs the collective
             return False
         from jax.experimental import multihost_utils
-        flags = multihost_utils.process_allgather(
-            np.asarray(1 if local else 0, np.int32))
+        with allow_transfers():
+            # the flag allgather is a sanctioned host collective —
+            # its fetch must pass the transfer guard
+            flags = multihost_utils.process_allgather(
+                np.asarray(1 if local else 0, np.int32))
         return bool(np.max(flags))
 
     def _preempt_exit(state, m, step):
@@ -180,11 +208,12 @@ def run_training(state: TrainState,
         save_s = None
         if ckpt_manager is not None:
             t0 = time.perf_counter()
-            if m is not None and ckpt_manager.latest_step() != step:
-                m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
-                ckpt_manager.save(step, save_view(state), metrics=m_host,
-                                  force=True)
-            ckpt_manager.wait()
+            with allow_transfers():
+                if m is not None and ckpt_manager.latest_step() != step:
+                    ckpt_manager.save(step, save_view(state),
+                                      metrics=_fetch_metrics(m),
+                                      force=True)
+                ckpt_manager.wait()
             save_s = time.perf_counter() - t0
             kept = ckpt_manager.latest_step()
             if kept != step:
@@ -215,6 +244,13 @@ def run_training(state: TrainState,
     # here — first-step compile and the resume fast-forward can
     # legitimately dwarf HEARTBEAT_TIMEOUT_S (worker_timeout_s bounds
     # that phase when needed)
+    #
+    # TRANSFER_GUARD teeth: the steady-state region below runs under
+    # jax's device→host transfer guard (thread-local, so the prefetch
+    # thread's h2d placement is untouched); every sanctioned fetch
+    # site inside wraps itself in allow_transfers()
+    _guard_region = contextlib.ExitStack()
+    _guard_region.enter_context(guards.transfer_ctx())
     try:
       for epoch in range(epochs):
         if _preempt_requested():
@@ -246,6 +282,10 @@ def run_training(state: TrainState,
                 meter.data_wait(wait_s)
             trained_this_epoch += 1
             if not loop_timing:
+                # DIVERGENCE_GUARD (multi-host, opt-in): every host
+                # must have lowered the SAME step program before the
+                # first collective dispatch wedges on a mismatch
+                guards.check_divergence(train_step, state, batch)
                 t_step0 = time.perf_counter()
                 state, m = train_step(state, batch)
                 # block: the first call's wall time must cover the
@@ -270,7 +310,7 @@ def run_training(state: TrainState,
                 # would sync — use the (static) batch token count instead
                 meter.update(int(np.prod(batch["inputs"].shape)))
             if log_every and global_step % log_every == 0:
-                m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
+                m_host = _fetch_metrics(m)
                 last_metrics = {"epoch": epoch, "step": global_step,
                                 **loop_timing, **m_host}
                 if meter is not None:
@@ -295,7 +335,7 @@ def run_training(state: TrainState,
                 # compute is booked as training, not stall
                 if meter is not None:
                     jax.block_until_ready(m)
-                with paused(meter):
+                with paused(meter), allow_transfers():
                     eval_metrics = eval_fn(state)
                 last_metrics.update(eval_metrics)
                 if tb_writer is not None:
@@ -306,8 +346,8 @@ def run_training(state: TrainState,
             # semantics, reference fine_tune_config.json:22-23)
             if ckpt_manager is not None and ckpt_every and \
                     global_step % ckpt_every == 0:
-                m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
-                with paused(meter):
+                m_host = _fetch_metrics(m)
+                with paused(meter), allow_transfers():
                     ckpt_manager.save(global_step, save_view(state),
                                       metrics=m_host)
             if fault_injector is not None:
@@ -338,22 +378,28 @@ def run_training(state: TrainState,
                 f"epoch {epoch} produced 0 batches — the dataset is "
                 "smaller than one global batch (shrink GLOBAL_BATCH / "
                 "PER_DEVICE_TRAIN_BATCH_SIZE or grow the dataset)")
-        m_host = {k: float(jax.device_get(v)) for k, v in m.items()}
+        m_host = _fetch_metrics(m)
         epoch_metrics = {"epoch": epoch, "step": global_step,
                          **loop_timing, **m_host}
         if meter is not None:
             epoch_metrics.update(meter.snapshot())
         if eval_fn is not None and eval_at_epoch_end:
-            epoch_metrics.update(eval_fn(state))
+            with allow_transfers():
+                epoch_metrics.update(eval_fn(state))
         if tb_writer is not None:
             tb_writer.log(global_step, epoch_metrics)
             tb_writer.flush()
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
-            ckpt_manager.save(global_step, save_view(state), metrics=m_host)
+            with allow_transfers():
+                ckpt_manager.save(global_step, save_view(state),
+                                  metrics=m_host)
         if report_fn is not None:
             report_fn(epoch_metrics)
     finally:
+        # leave the transfer-guard region before the post-loop export/
+        # merge work — only the hot loop is guarded
+        _guard_region.close()
         # a failing step must still flush an in-flight trace — the
         # profile matters most in exactly that case
         if profiler is not None:

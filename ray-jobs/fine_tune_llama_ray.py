@@ -353,10 +353,14 @@ def train_loop_per_worker(config: dict):
     # global sharded arrays; identical path single-host
     place = make_place_batch(mesh, context_sharded=ctx_sharded)
 
+    # shardlint runtime guards (analysis/guards.py): TRANSFER_GUARD /
+    # DIVERGENCE_GUARD resolved config-key-first, env fallback
+    from gke_ray_train_tpu.analysis.guards import RuntimeGuards
     state, metrics = run_training(
         state, step_fn, epoch_batches,
         epochs=epochs,
         place_batch=place,
+        guards=RuntimeGuards.from_config(config),
         # asynchronous input pipeline (data/prefetch.py): tokenize/pack +
         # sharded host→device transfer overlap the train step; depth 2
         # device-resident batches by default, 0 = synchronous

@@ -149,10 +149,14 @@ def train_loop_per_worker(config: dict):
 
     meter = ThroughputMeter(cfg, seq_len=seq_len,
                             n_devices=len(jax.devices()))
+    from gke_ray_train_tpu.analysis.guards import RuntimeGuards
     from gke_ray_train_tpu.train.profiling import profiler_from_config
     state, metrics = run_training(
         state, step_fn, lambda e: batches.iter_epoch(e),
         epochs=epochs,
+        # shardlint runtime guards: TRANSFER_GUARD / DIVERGENCE_GUARD
+        # (analysis/guards.py), config-key-first with env fallback
+        guards=RuntimeGuards.from_config(config),
         # host-local rows → global sharded arrays (SURVEY.md row D9)
         place_batch=make_place_batch(
             mesh, context_sharded=mesh.shape["context"] > 1),

@@ -50,6 +50,12 @@ run compile        env BENCH_MODE=compile python bench.py
 # after an INTENTIONAL cost change, and review the JSON diff like code.
 run budget-check   python -m gke_ray_train_tpu.perf.budget check
 
+# shardlint (gke_ray_train_tpu/analysis): the AST pass over the repo
+# plus the trace-level analyzers on the canonical CPU mesh — no
+# unbudgeted reshard collectives, donation held, one compile per fn
+run shardlint      python -m gke_ray_train_tpu.analysis lint
+run shardlint-check python -m gke_ray_train_tpu.analysis check
+
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
 # defaults on the seq4k shape where the kernel dominates (up to 8 extra
 # bench runs; the default q=256/kv=1024 cell IS the `seq4k` record

@@ -1,9 +1,11 @@
 """Shared two-process jax.distributed harness for entry-script tests.
 
-Spawns N real processes (CPU backend, 4 fake devices each) running an
-entry module's ``train_loop_per_worker`` with a shared JSON config, and
-asserts every worker exits cleanly. A hang is the expected failure mode
-of multi-host bugs, so workers run under a wall-clock timeout.
+Spawns N real processes (CPU backend, 4 fake devices each) running
+either an entry module's ``train_loop_per_worker`` with a shared JSON
+config (:func:`run_entry_multiprocess`) or an arbitrary snippet
+(:func:`run_snippet_multiprocess`), and asserts every worker exits
+cleanly with its expected token. A hang is the expected failure mode of
+multi-host bugs, so workers run under one shared wall-clock deadline.
 """
 
 import json
@@ -38,6 +40,16 @@ assert metrics and "loss" in metrics, metrics
 print("WORKER_OK", jax.process_index(), flush=True)
 """
 
+_SNIPPET_CODE = """
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+from gke_ray_train_tpu.parallel.mesh import distributed_init
+distributed_init()
+{body}
+"""
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -45,17 +57,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def run_entry_multiprocess(script: str, config: dict, *,
-                           num_processes: int = 2,
-                           devices_per_process: int = 4,
-                           timeout: float = 900,
-                           extra_env: dict = None,
-                           expect: str = "ok") -> list:
-    """Run ray-jobs/<script>'s worker fn across real processes; returns
-    the per-rank stdout. Raises AssertionError with the failing rank's
-    tail on any non-zero exit. ``extra_env`` reaches every worker (e.g.
-    FAULT_SPEC for the fault-injection drills); ``expect`` is "ok" or
-    "preempted" (every rank must exit with that status)."""
+def _run_worker_processes(code: str, *, num_processes: int,
+                          devices_per_process: int, timeout: float,
+                          extra_env: dict, token: str) -> list:
+    """The shared orchestration core: spawn ``num_processes`` real
+    jax.distributed workers running ``code``, enforce ONE shared
+    deadline (an all-workers deadlock must cost ~1x the timeout, not
+    num_processes x), reclaim stragglers, and assert every rank exited
+    0 printing ``f"{token} {rank}"``. Returns the per-rank stdout."""
     port = free_port()
     procs = []
     for rank in range(num_processes):
@@ -63,28 +72,22 @@ def run_entry_multiprocess(script: str, config: dict, *,
         env.update(extra_env or {})
         env.update({
             "JAX_PLATFORMS": "cpu",
-            "HF_HUB_OFFLINE": "1",   # fail fast to offline fallbacks
             "XLA_FLAGS": "--xla_force_host_platform_device_count="
                          f"{devices_per_process}",
             "COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
             "NUM_PROCESSES": str(num_processes),
             "PROCESS_ID": str(rank),
             "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
-            "MULTIHOST_SMOKE_CONFIG": json.dumps(config),
         })
         procs.append(subprocess.Popen(
-            [sys.executable, "-c",
-             _WORKER_CODE.format(repo=REPO, script=script)],
+            [sys.executable, "-c", code],
             env=env, cwd=REPO, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True))
-    outs = []
-    hung = []
+    outs, hung = [], []
     import time
     deadline = time.monotonic() + timeout
     for rank, p in enumerate(procs):
         try:
-            # one shared deadline: an all-workers deadlock must cost ~1x
-            # the timeout, not num_processes x
             out, _ = p.communicate(
                 timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
@@ -101,10 +104,51 @@ def run_entry_multiprocess(script: str, config: dict, *,
     assert not hung, (
         f"worker(s) {hung} hung past {timeout}s; outputs:\n" +
         "\n---\n".join(o[-2000:] for o in outs))
-    token = {"ok": "WORKER_OK", "preempted": "WORKER_PREEMPTED"}[expect]
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {rank} failed (rc={p.returncode}):\n{out[-4000:]}")
         assert f"{token} {rank}" in out, (
-            f"worker {rank} did not exit '{expect}':\n{out[-2000:]}")
+            f"worker {rank} did not print '{token} {rank}':\n"
+            f"{out[-2000:]}")
     return outs
+
+
+def run_entry_multiprocess(script: str, config: dict, *,
+                           num_processes: int = 2,
+                           devices_per_process: int = 4,
+                           timeout: float = 900,
+                           extra_env: dict = None,
+                           expect: str = "ok") -> list:
+    """Run ray-jobs/<script>'s worker fn across real processes; returns
+    the per-rank stdout. Raises AssertionError with the failing rank's
+    tail on any non-zero exit. ``extra_env`` reaches every worker (e.g.
+    FAULT_SPEC for the fault-injection drills); ``expect`` is "ok" or
+    "preempted" (every rank must exit with that status)."""
+    env = dict(extra_env or {})
+    env.update({
+        "HF_HUB_OFFLINE": "1",   # fail fast to offline fallbacks
+        "MULTIHOST_SMOKE_CONFIG": json.dumps(config),
+    })
+    return _run_worker_processes(
+        _WORKER_CODE.format(repo=REPO, script=script),
+        num_processes=num_processes,
+        devices_per_process=devices_per_process, timeout=timeout,
+        extra_env=env,
+        token={"ok": "WORKER_OK", "preempted": "WORKER_PREEMPTED"}[expect])
+
+
+def run_snippet_multiprocess(body: str, *, num_processes: int = 2,
+                             devices_per_process: int = 4,
+                             timeout: float = 300,
+                             extra_env: dict = None,
+                             token: str = "WORKER_OK") -> list:
+    """Run an arbitrary snippet under real jax.distributed processes.
+    The snippet runs after ``distributed_init()`` and must print
+    ``f"{token} {rank}"`` on the outcome it asserts — the guard drills
+    print their own tokens (e.g. WORKER_DIVERGED) so a silent wrong
+    path can't pass."""
+    return _run_worker_processes(
+        _SNIPPET_CODE.format(repo=REPO, body=body),
+        num_processes=num_processes,
+        devices_per_process=devices_per_process, timeout=timeout,
+        extra_env=extra_env or {}, token=token)

@@ -1,0 +1,547 @@
+"""shardlint (gke_ray_train_tpu/analysis): AST rules, trace-level
+analyzers, and runtime guards — all on the 8-fake-device CPU mesh.
+
+Every AST rule is proven both ways: a minimal bad snippet fires it, the
+fixed twin is clean. The recompile detector catches an injected
+shape-churn loop; the divergence guard catches a fabricated (fast) and
+a real 2-process (slow) HLO mismatch; the transfer-guarded loop runs
+clean on a tiny model.
+"""
+
+import base64
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.analysis import guards, jaxprcheck
+from gke_ray_train_tpu.analysis.astlint import (
+    default_mesh_vocabulary, lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def codes(src):
+    return [f.code for f in lint_source(textwrap.dedent(src))]
+
+
+# ---------------------------------------------------------------------------
+# level 1: each rule fires on its minimal bad snippet, not on the twin
+# ---------------------------------------------------------------------------
+
+def test_tpu001_host_sync_in_traced_fn():
+    bad = """
+        import jax
+        def train_step(state, batch):
+            loss = compute(state, batch)
+            host = jax.device_get(loss)
+            lr = float(state.step)
+            probe = loss.item()
+            return state, {"loss": loss}
+    """
+    assert codes(bad).count("TPU001") == 3
+    fixed = """
+        import jax
+        def train_step(state, batch):
+            loss = compute(state, batch)
+            return state, {"loss": loss}
+    """
+    assert codes(fixed) == []
+
+
+def test_tpu001_per_element_device_get():
+    bad = """
+        import jax
+        def log_metrics(m):
+            return {k: float(jax.device_get(v)) for k, v in m.items()}
+    """
+    assert codes(bad) == ["TPU001"]
+    fixed = """
+        import jax
+        def log_metrics(m):
+            host = jax.device_get(m)
+            return {k: float(v) for k, v in host.items()}
+    """
+    assert codes(fixed) == []
+
+
+def test_tpu001_reaches_through_call_chain():
+    """A helper called FROM train_step is jit-reachable too."""
+    bad = """
+        import jax
+        def lossfn(params, batch):
+            l = compute(params, batch)
+            return float(jax.device_get(l))
+        def train_step(state, batch):
+            return state, {"loss": lossfn(state, batch)}
+    """
+    assert "TPU001" in codes(bad)
+
+
+def test_tpu002_partition_spec_vocabulary():
+    bad = """
+        from jax.sharding import PartitionSpec as P
+        spec = P("fsdb", None)
+        nested = P(("data", "fspd"), None)
+    """
+    assert codes(bad) == ["TPU002", "TPU002"]
+    fixed = """
+        from jax.sharding import PartitionSpec as P
+        spec = P("fsdp", None)
+        nested = P(("data", "fsdp"), None)
+    """
+    assert codes(fixed) == []
+
+
+def test_tpu002_vocabulary_comes_from_mesh_py():
+    vocab = default_mesh_vocabulary()
+    assert vocab == {"data", "fsdp", "model", "context", "pipe"}
+
+
+def test_tpu003_step_like_jit_without_donation():
+    bad = """
+        import jax
+        def train_step(state, batch):
+            new_state = update(state, batch)
+            return new_state, {}
+        f = jax.jit(train_step)
+    """
+    assert "TPU003" in codes(bad)
+    fixed = """
+        import jax
+        def train_step(state, batch):
+            new_state = update(state, batch)
+            return new_state, {}
+        f = jax.jit(train_step, donate_argnums=(0,))
+    """
+    assert codes(fixed) == []
+    # eval-like (state in, scalars out) needs no donation
+    not_step = """
+        import jax
+        def eval_step(state, batch):
+            return compute(state, batch)
+        f = jax.jit(eval_step)
+    """
+    assert "TPU003" not in codes(not_step)
+
+
+def test_tpu004_impure_traced_code():
+    bad = """
+        import numpy as np
+        import time
+        def train_step(state, batch):
+            noise = np.random.normal(size=(4,))
+            t = time.time()
+            return state, {}
+    """
+    assert codes(bad).count("TPU004") == 2
+    fixed = """
+        import jax
+        def train_step(state, batch, key):
+            noise = jax.random.normal(key, (4,))
+            return state, {}
+    """
+    assert codes(fixed) == []
+
+
+def test_tpu005_host_data_array_in_traced_fn():
+    bad = """
+        import numpy as np
+        import jax.numpy as jnp
+        def train_step(state, batch):
+            table = jnp.array([1.0, 2.0, 3.0])
+            table2 = jnp.asarray(np.arange(8))
+            return state, {}
+    """
+    assert codes(bad).count("TPU005") == 2
+    fixed = """
+        import jax.numpy as jnp
+        TABLE = jnp.array([1.0, 2.0, 3.0])
+        def train_step(state, batch):
+            return state, {"t": TABLE}
+    """
+    assert codes(fixed) == []
+
+
+def test_suppression_needs_reason():
+    with_reason = """
+        import numpy as np
+        def train_step(state, batch):
+            n = np.random.normal()  # shardlint: disable=TPU004 -- drill fixture
+            return state, {}
+    """
+    assert codes(with_reason) == []
+    without = """
+        import numpy as np
+        def train_step(state, batch):
+            n = np.random.normal()  # shardlint: disable=TPU004
+            return state, {}
+    """
+    assert codes(without) == ["TPU000"]
+
+
+def test_lint_cli_exit_codes(tmp_path):
+    """The CLI exits non-zero on a fixture carrying every rule, zero on
+    clean source (subprocess = the exact CI contract)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        SPEC = P("fsdb")                                   # TPU002
+        def train_step(state, batch):
+            t = time.time()                                # TPU004
+            tbl = jnp.array([1.0])                         # TPU005
+            lr = float(state.step)                         # TPU001
+            return state, {}
+        f = jax.jit(train_step)                            # TPU003
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.analysis", "lint",
+         str(bad), "--fail-on-findings"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    for code in ("TPU001", "TPU002", "TPU003", "TPU004", "TPU005"):
+        assert code in r.stdout, (code, r.stdout)
+
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.analysis", "lint",
+         str(good)],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_repo_lints_clean():
+    """The acceptance gate: the repo itself carries zero findings (and
+    zero reasonless suppressions) at HEAD."""
+    r = subprocess.run(
+        [sys.executable, "-m", "gke_ray_train_tpu.analysis", "lint"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# level 2: recompile detector, collective/donation analyzers
+# ---------------------------------------------------------------------------
+
+def test_recompile_detector_catches_shape_churn():
+    def churny_step(x):
+        return x * 2.0
+
+    f = jax.jit(churny_step)
+    with jaxprcheck.RecompileDetector() as det:
+        for n in (3, 4, 5):
+            f(jnp.ones((n,)))
+    rec = det.recompiled()
+    assert "churny_step" in rec and len(rec["churny_step"]) == 3, rec
+    churn = jaxprcheck.RecompileDetector.describe_churn(rec["churny_step"])
+    assert "float32[3]" in churn and "float32[4]" in churn, churn
+    assert det.findings()
+    # op-level primitive jits never pollute the table
+    assert not any(k in rec for k in ("broadcast_in_dim",
+                                      "convert_element_type"))
+
+
+def test_recompile_detector_quiet_on_stable_signature():
+    f = jax.jit(lambda x: x + 1)
+    with jaxprcheck.RecompileDetector() as det:
+        f(jnp.ones((4,)))
+        f(jnp.ones((4,)) * 2)
+    assert det.recompiled() == {}
+
+
+def test_recompile_limit_hard_error():
+    f = jax.jit(lambda x: x - 1)
+    assert guards.install_recompile_limit(limit=1)
+    try:
+        f(jnp.ones((2,)))
+        with pytest.raises(guards.RecompileLimitExceeded) as ei:
+            f(jnp.ones((3,)))
+        assert "compiled 2 times" in str(ei.value)
+    finally:
+        guards.uninstall_recompile_limit()
+    f(jnp.ones((4,)))  # churn is free again once disarmed
+
+
+def test_recompile_limit_env_knob(monkeypatch):
+    monkeypatch.setenv("RECOMPILE_LIMIT", "0")
+    assert not guards.install_recompile_limit()
+    monkeypatch.setenv("RECOMPILE_LIMIT", "3")
+    assert guards.install_recompile_limit()
+    guards.uninstall_recompile_limit()
+    # config key wins over env
+    assert not guards.install_recompile_limit(
+        config={"RECOMPILE_LIMIT": 0})
+
+
+def test_unbudgeted_collectives_flagged():
+    budget = {"collective_counts": {"all-reduce": 2},
+              "collective_lines": ["x = f32[4] all-reduce(y)"]}
+    clean = {"collective_counts": {"all-reduce": 2},
+             "collective_lines": ["x = f32[4] all-reduce(y)"]}
+    assert jaxprcheck.unbudgeted_collectives(clean, budget) == []
+    dirty = {"collective_counts": {"all-reduce": 2, "all-gather": 1},
+             "collective_lines": ["x = f32[4] all-reduce(y)",
+                                  "z = f32[8] all-gather(w)"]}
+    out = jaxprcheck.unbudgeted_collectives(dirty, budget)
+    assert len(out) == 1 and "all-gather" in out[0]
+    assert "HLO +" in out[0], out[0]
+
+
+def test_donation_findings(fsdp_mesh):
+    from gke_ray_train_tpu.perf.budget import build_preset_step
+    undonated, state, _ = build_preset_step("tiny_dp8", donate=False)
+    found = jaxprcheck.donation_findings(undonated, state)
+    assert found and "donation did not hold" in found[0], found
+    donated, state_d, _ = build_preset_step("tiny_dp8", donate=True)
+    assert jaxprcheck.donation_findings(donated, state_d) == []
+
+
+def test_check_preset_clean_on_tiny_dp8():
+    """The acceptance gate for the trace-level `check` verb: the real
+    preset passes all three analyzers on the CI mesh."""
+    assert jaxprcheck.check_preset("tiny_dp8") == []
+
+
+def test_check_catches_injected_collective():
+    """The same smuggled-collective trick the budget tests use must
+    surface through the analysis path with the offending HLO lines."""
+    from gke_ray_train_tpu.perf.budget import (
+        build_preset_step, budget_path, load_budget)
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+
+    def wrap(inner):
+        def with_extra(state, batch):
+            st, m = inner(state, batch)
+            m = dict(m)
+            m["pnorm2"] = sum(jnp.vdot(x, x)
+                              for x in jax.tree.leaves(st.params))
+            return st, m
+        return with_extra
+
+    compiled, _, _ = build_preset_step("tiny_fsdp8", wrap=wrap)
+    rep = step_cost_report(compiled)
+    out = jaxprcheck.unbudgeted_collectives(
+        rep, load_budget(budget_path("tiny_fsdp8")))
+    assert out and "beyond the budgeted set" in out[0], out
+    assert "HLO +" in out[0]
+
+
+# ---------------------------------------------------------------------------
+# level 3: runtime guards
+# ---------------------------------------------------------------------------
+
+def test_transfer_guard_mode_parsing(monkeypatch):
+    monkeypatch.delenv("TRANSFER_GUARD", raising=False)
+    assert guards.transfer_guard_mode() is None
+    monkeypatch.setenv("TRANSFER_GUARD", "disallow")
+    assert guards.transfer_guard_mode() == "disallow"
+    assert guards.transfer_guard_mode({"TRANSFER_GUARD": "off"}) is None
+    monkeypatch.setenv("TRANSFER_GUARD", "bogus")
+    assert guards.transfer_guard_mode() is None  # warn, fail open
+
+
+def test_transfer_guard_ctx_sets_jax_config():
+    with guards.transfer_guard_ctx("disallow"):
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+        with guards.allow_transfers():
+            assert jax.config.jax_transfer_guard_device_to_host == "allow"
+        assert jax.config.jax_transfer_guard_device_to_host == "disallow"
+
+
+def test_transfer_guarded_loop_runs_clean(dp_mesh, tmp_path):
+    """The tiny preset trains under TRANSFER_GUARD=disallow: every
+    host fetch the loop performs goes through the allow-list."""
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, max_seq_len=16)
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=dp_mesh)
+    step = make_train_step(cfg, opt, mesh=dp_mesh, donate=False)
+
+    def epoch_batches(epoch):
+        rng = np.random.default_rng(epoch)
+        for _ in range(4):
+            toks = rng.integers(0, 64, (8, 17), dtype=np.int32)
+            yield {"inputs": toks[:, :-1], "targets": toks[:, 1:],
+                   "weights": np.ones((8, 16), np.float32)}
+
+    state, metrics = run_training(
+        state, step, epoch_batches, epochs=1, log_every=2,
+        place_batch=make_place_batch(dp_mesh),
+        guards=guards.RuntimeGuards(transfer_mode="disallow"))
+    assert "loss" in metrics and np.isfinite(metrics["loss"])
+    assert int(jax.device_get(state.step)) == 4
+
+
+class _FakeKVClient:
+    """jax.distributed KV store double: the peer rank's values are
+    served from this rank's own writes, corrupted to fabricate a
+    divergent peer (corrupt_rank=None = agreeing peer)."""
+
+    def __init__(self, own_rank, corrupt_rank=None):
+        self.kv = {}
+        self.own = own_rank
+        self.bad = corrupt_rank
+
+    def key_value_set(self, k, v):
+        self.kv[k] = v
+
+    def wait_at_barrier(self, name, timeout_ms):
+        pass
+
+    def blocking_key_value_get(self, k, timeout_ms):
+        own_key = k[: k.rfind("/")] + f"/{self.own}"
+        if self.bad is not None and k.endswith(f"/{self.bad}"):
+            raw = base64.b64decode(self.kv[own_key]).decode()
+            return base64.b64encode(
+                ("DIVERGED\n" + raw).encode()).decode()
+        return self.kv.get(k, self.kv[own_key])
+
+
+@pytest.mark.parametrize("rank,peer", [(1, 0), (0, 1)])
+def test_divergence_guard_fast(monkeypatch, rank, peer):
+    """A fabricated 2-host mismatch raises with per-host fingerprints
+    and a real unified diff FROM EVERY RANK'S PERSPECTIVE — rank 0's
+    error must carry the diff too, not an empty self-comparison."""
+    f = jax.jit(lambda x: x * 3.0)
+    x = jnp.ones((4,))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: rank)
+
+    fake = _FakeKVClient(own_rank=rank, corrupt_rank=peer)
+    monkeypatch.setattr(guards, "_distributed_client", lambda: fake)
+    with pytest.raises(guards.HloDivergenceError) as ei:
+        guards.check_host_hlo_agreement(f, x, label="step")
+    msg = str(ei.value)
+    assert "host 0" in msg and "host 1" in msg
+    assert "DIVERGED" in msg  # the diff names the offending line
+    assert f"host {rank} (this host)" in msg
+
+
+def test_divergence_guard_fast_agreement(monkeypatch):
+    f = jax.jit(lambda x: x * 3.0)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    agree = _FakeKVClient(own_rank=1)
+    monkeypatch.setattr(guards, "_distributed_client", lambda: agree)
+    assert guards.check_host_hlo_agreement(
+        f, jnp.ones((4,)), label="step") is not None
+
+
+def test_divergence_guard_mixed_text_sources_not_divergence(monkeypatch):
+    """One host re-texts its AOT executable, the peer lowered fresh —
+    the digests differ ONLY because the formats do. The guard must
+    re-derive via lower() on every host and agree, never kill a
+    healthy run over a text-format mismatch."""
+
+    class StubStep:
+        def __init__(self):
+            class _C:
+                def as_text(self):
+                    return "EXEC-FORMAT TEXT"
+            self._compiled = _C()
+            self.lowered = 0
+
+        def lower(self, *a):
+            self.lowered += 1
+
+            class _L:
+                def as_text(self):
+                    return "MLIR TEXT"
+            return _L()
+
+    step = StubStep()
+    mlir_payload = base64.b64encode(
+        ("mlir\n" + guards.hlo_fingerprint("MLIR TEXT")).encode()).decode()
+
+    class MixedClient:
+        def __init__(self):
+            self.kv = {}
+
+        def key_value_set(self, k, v):
+            self.kv[k] = v
+
+        def wait_at_barrier(self, *a):
+            pass
+
+        def blocking_key_value_get(self, k, t):
+            # rank 0 = own writes; rank 1 = a peer that lowered fresh
+            return self.kv.get(k, mlir_payload)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(guards, "_distributed_client",
+                        lambda: MixedClient())
+    got = guards.check_host_hlo_agreement(step, label="step")
+    assert got == guards.hlo_fingerprint("MLIR TEXT")
+    assert step.lowered == 1  # re-derived exactly once, then agreed
+
+
+def test_divergence_guard_single_process_noop():
+    assert guards.check_host_hlo_agreement(
+        jax.jit(lambda x: x), jnp.ones(())) is None
+
+
+def test_runtime_guards_from_config(monkeypatch):
+    monkeypatch.delenv("TRANSFER_GUARD", raising=False)
+    monkeypatch.delenv("DIVERGENCE_GUARD", raising=False)
+    g = guards.RuntimeGuards.from_config()
+    assert g.transfer_mode is None and not g.divergence
+    g = guards.RuntimeGuards.from_config(
+        {"TRANSFER_GUARD": "log", "DIVERGENCE_GUARD": 1})
+    assert g.transfer_mode == "log" and g.divergence
+
+
+@pytest.mark.slow
+def test_divergence_guard_two_process_drill():
+    """Two REAL jax.distributed processes lower different step programs
+    (data-dependent constant); every rank must fail fast with the
+    per-host diff instead of wedging in the first collective."""
+    from tests._multihost import run_snippet_multiprocess
+    body = """
+import jax.numpy as jnp
+from gke_ray_train_tpu.analysis import guards
+rank = jax.process_index()
+k = 2.0 if rank == 1 else 1.0   # the divergence under test
+f = jax.jit(lambda x: x * k)
+try:
+    guards.check_host_hlo_agreement(f, jnp.ones((4,)), label="step")
+    print("WORKER_NO_DIVERGENCE", rank, flush=True)
+except guards.HloDivergenceError as e:
+    s = str(e)
+    assert "host 0" in s and "host 1" in s, s[:500]
+    # EVERY rank's error carries a real diff of its own program vs the
+    # disagreeing peer (not an empty self-comparison on rank 0)
+    assert any(l.startswith(("+", "-")) for l in s.splitlines()), s[:800]
+    print("WORKER_DIVERGED", rank, flush=True)
+"""
+    run_snippet_multiprocess(body, token="WORKER_DIVERGED", timeout=240)
+
+
+@pytest.mark.slow
+def test_divergence_guard_two_process_agreement():
+    from tests._multihost import run_snippet_multiprocess
+    body = """
+import jax.numpy as jnp
+from gke_ray_train_tpu.analysis import guards
+f = jax.jit(lambda x: x * 2.0)
+assert guards.check_host_hlo_agreement(f, jnp.ones((4,))) is not None
+print("WORKER_OK", jax.process_index(), flush=True)
+"""
+    run_snippet_multiprocess(body, token="WORKER_OK", timeout=240)

@@ -130,3 +130,74 @@ def test_multislice_train_step_runs():
     }
     state, m = step(state, place(b))
     assert jnp.isfinite(m["loss"])
+
+
+class _FakeSliceDevice:
+    """A CPU device wearing a ``slice_index`` — drives build_mesh down
+    the REAL create_hybrid_device_mesh path (the one actual multi-slice
+    TPU hardware takes) with no TPU attached."""
+
+    def __init__(self, dev, slice_index):
+        self._dev = dev
+        self.slice_index = slice_index
+
+    def __getattr__(self, name):
+        return getattr(self._dev, name)
+
+    def __repr__(self):
+        return f"FakeSliceDev(id={self._dev.id}, slice={self.slice_index})"
+
+
+def _slice_ids(mesh):
+    import numpy as np
+    return np.vectorize(lambda d: d.slice_index)(mesh.devices)
+
+
+def test_multislice_data_axis_spans_dcn_contract(devices):
+    """VERDICT open item 7, pinned: on a multi-slice mesh the `data`
+    axis — and ONLY the `data` axis — crosses slice (DCN) boundaries;
+    fsdp/model/context/pipe traffic stays intra-slice (ICI). A mesh
+    refactor that silently puts FSDP all-gathers on DCN fails here."""
+    from gke_ray_train_tpu.parallel.mesh import (
+        MESH_AXES, MeshConfig, build_mesh)
+
+    fake = [_FakeSliceDevice(d, d.id // 4) for d in devices]
+    for shape in (dict(data=2, fsdp=4), dict(data=2, fsdp=2, model=2),
+                  dict(data=2, fsdp=1, model=2, context=2)):
+        mesh = build_mesh(MeshConfig(num_slices=2, **shape), fake)
+        sl = _slice_ids(mesh)
+        data_ax = MESH_AXES.index("data")
+        # slice id must be CONSTANT along every non-data axis...
+        for ax, name in enumerate(MESH_AXES):
+            if name == "data":
+                continue
+            assert (sl == sl.take([0], axis=ax)).all(), (
+                f"{shape}: axis {name!r} crosses slice boundaries — "
+                f"its collectives would ride DCN\n{sl}")
+        # ...and the data axis must actually SPAN the slices
+        # (slice-id-major: one contiguous block of data coords per
+        # slice, so only batch-gradient reduction crosses DCN)
+        spans = {tuple(sl.take(i, axis=data_ax).ravel().tolist())
+                 for i in range(sl.shape[data_ax])}
+        assert len(spans) == 2, f"{shape}: data axis does not span DCN"
+        for block in spans:
+            assert len(set(block)) == 1, (
+                f"{shape}: a data coordinate mixes slices {block}")
+
+
+def test_multislice_emulated_layout_same_contract(devices, caplog):
+    """The fake/CPU fallback (no slice_index attr) must emulate the
+    same DCN-outermost layout: contiguous device blocks act as slices,
+    spanned only by `data`."""
+    import logging
+    import numpy as np
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    with caplog.at_level(logging.WARNING):
+        mesh = build_mesh(MeshConfig(data=2, fsdp=4, num_slices=2),
+                          devices)
+    assert any("no slice_index" in r.message for r in caplog.records)
+    # emulated slice id: contiguous blocks of the given device order
+    order = {d.id: i for i, d in enumerate(devices)}
+    sl = np.vectorize(lambda d: order[d.id] // 4)(mesh.devices)
+    assert (sl[0] == 0).all() and (sl[1] == 1).all(), sl
