@@ -123,6 +123,14 @@ def _emit(metric, value, unit, extra, compare_baseline=True):
     if os.environ.get("BENCH_FALLBACK_REASON"):
         result["fallback_reason"] = \
             os.environ["BENCH_FALLBACK_REASON"][:200]
+    # the ExecutionPlan identity of this bench process (env dialect,
+    # plan.py) — the same fingerprint budget JSONs and AOT sidecar
+    # keys carry, so a BENCH record names the plan it measured
+    try:
+        from gke_ray_train_tpu.plan import ExecutionPlan
+        result["plan_fingerprint"] = ExecutionPlan.from_env().fingerprint()
+    except Exception as e:  # noqa: BLE001 - provenance is best-effort
+        result["plan_fingerprint"] = f"unresolvable: {e}"[:80]
     print(json.dumps(result))
     on_tpu = devices[0].platform != "cpu"
     if compare_baseline and baseline is None and on_tpu and \

@@ -29,3 +29,7 @@ from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
     AXIS_PIPE,
     MESH_AXES,
 )
+from gke_ray_train_tpu.plan import (  # noqa: F401
+    ExecutionPlan,
+    compile_step_with_plan,
+)

@@ -15,7 +15,13 @@ Level 3 (:mod:`analysis.guards`): opt-in production teeth —
 retrace churn a hard error, ``DIVERGENCE_GUARD`` fails fast (with a
 per-host diff) when multi-host step programs diverge.
 
-CLI: ``python -m gke_ray_train_tpu.analysis lint|trace|check``.
+Level 4 (:mod:`analysis.plancheck`): static ExecutionPlan
+verification — topology feasibility and model-dim divisibility by
+pure shape arithmetic + ``jax.eval_shape``, the checkpoint-portability
+matrix across fake-device topologies, and cross-artifact consistency
+(budget fingerprints, KNOWN_KEYS drift). No backend, no hardware.
+
+CLI: ``python -m gke_ray_train_tpu.analysis lint|trace|check|plancheck``.
 """
 
 from gke_ray_train_tpu.analysis.astlint import (  # noqa: F401
@@ -27,3 +33,6 @@ from gke_ray_train_tpu.analysis.guards import (  # noqa: F401
     GuardViolation, HloDivergenceError, RecompileLimitExceeded,
     RuntimeGuards, allow_transfers, check_host_hlo_agreement,
     install_recompile_limit, uninstall_recompile_limit)
+from gke_ray_train_tpu.analysis.plancheck import (  # noqa: F401
+    PlanFinding, check_config, check_config_file, check_paths,
+    drift_findings, feasibility_findings, portability_findings)

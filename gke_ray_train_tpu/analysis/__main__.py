@@ -1,14 +1,20 @@
 """The shardlint CLI — ``python -m gke_ray_train_tpu.analysis``.
 
-``lint``   AST pass (level 1) over the repo source; exit 1 on findings.
-``trace``  print the level-2 compile ledger per preset (informational).
-``check``  level-2 assertions per preset (unbudgeted collectives,
-           dropped donation, recompiles); exit 1 on findings.
+``lint``      AST pass (level 1) over the repo source; exit 1 on findings.
+``trace``     print the level-2 compile ledger per preset (informational).
+``check``     level-2 assertions per preset (unbudgeted collectives,
+              dropped donation, recompiles); exit 1 on findings.
+``plancheck`` level-4 static ExecutionPlan verification (plancheck.py):
+              topology feasibility, model-dim divisibility, the
+              checkpoint-portability matrix, budget/fingerprint
+              consistency, KNOWN_KEYS drift; exit 1 on findings.
 
 ``trace``/``check`` need the canonical 8-fake-device CPU mesh, so —
 like ``perf.budget`` — they re-exec themselves into a child with the
 forced-CPU env when not already on it. ``lint`` is pure AST and runs
-anywhere (the CI lint step needs no jax backend at all).
+anywhere; ``plancheck`` is pure shape arithmetic + ``jax.eval_shape``
+(no backend, no devices — it never probes the possibly-dead
+accelerator), so both run on the CI lint runner.
 """
 
 from __future__ import annotations
@@ -49,6 +55,33 @@ def _preset_names(names: List[str]) -> List[str]:
     return names or sorted(PRESETS)
 
 
+def _plancheck(paths: List[str], budget_dir: str = None) -> int:
+    # plancheck is static: make sure abstract tracing can never probe a
+    # (possibly dead) accelerator backend, exactly like the tier-1 env
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from gke_ray_train_tpu.analysis.plancheck import (
+        check_paths, default_config_paths)
+    paths = paths or default_config_paths(REPO_ROOT)
+    findings = check_paths(paths, budget_dir=budget_dir)
+    for f in findings:
+        print(f"FINDING {f}")
+    if findings:
+        print(f"plancheck: {len(findings)} finding(s) over "
+              f"{len(paths)} config(s)")
+        return 1
+    import json as _json
+
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    for p in paths:
+        with open(p) as fh:
+            plan = ExecutionPlan.from_config(_json.load(fh))
+        print(f"{os.path.relpath(p, REPO_ROOT)}: plan "
+              f"{plan.fingerprint()} feasible on {plan.topology}; "
+              "portability + budget + KNOWN_KEYS consistent")
+    print(f"plancheck: clean ({len(paths)} config(s))")
+    return 0
+
+
 def _reexec_on_cpu_mesh(argv: List[str]) -> int:
     from gke_ray_train_tpu.perf.cache import cpu_mesh_env
     return subprocess.run(
@@ -71,6 +104,7 @@ def _trace(names: List[str]) -> int:
 
 def _check(names: List[str]) -> int:
     from gke_ray_train_tpu.analysis.jaxprcheck import check_preset
+    from gke_ray_train_tpu.perf.budget import plan_for_preset
     rc = 0
     for name in _preset_names(names):
         findings = check_preset(name)
@@ -79,8 +113,12 @@ def _check(names: List[str]) -> int:
         if findings:
             rc = 1
         else:
+            # the fingerprint printed here is the SAME ExecutionPlan
+            # identity the budget CLI and the budget JSON carry — one
+            # plan across trainer, budget check and analysis check
             print(f"{name}: clean (collectives within budget, donation "
-                  "held, one compile per fn)")
+                  "held, one compile per fn; plan "
+                  f"{plan_for_preset(name).fingerprint()})")
     return rc
 
 
@@ -103,10 +141,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_check = sub.add_parser(
         "check", help="assert collectives/donation/compile-once per preset")
     p_check.add_argument("names", nargs="*")
+    p_plan = sub.add_parser(
+        "plancheck",
+        help="statically verify ExecutionPlans: feasibility, "
+             "portability matrix, budget/fingerprint + KNOWN_KEYS "
+             "consistency (no backend needed)")
+    p_plan.add_argument("configs", nargs="*",
+                        help="config JSONs (default: the shipped "
+                             "ray-jobs/fine_tune_config*.json presets)")
+    p_plan.add_argument("--budget-dir", default=None,
+                        help="budget directory (default tests/budgets)")
     args = parser.parse_args(argv)
 
     if args.command == "lint":
         return _lint(args.paths)
+    if args.command == "plancheck":
+        return _plancheck(args.configs, args.budget_dir)
     if os.environ.get("_ANALYSIS_CLI_NATIVE") != "1" \
             and not _on_canonical_mesh():
         return _reexec_on_cpu_mesh([args.command] + args.names)

@@ -111,12 +111,16 @@ class Finding:
 
 
 # ---------------------------------------------------------------------------
-# mesh-axis vocabulary: parsed from parallel/mesh.py, never hardcoded —
-# adding a mesh axis must not require touching the linter
+# mesh-axis vocabulary: read from the ExecutionPlan's declared axis
+# names (plan.py), never hardcoded — adding a mesh axis must not
+# require touching the linter
 # ---------------------------------------------------------------------------
 
 def mesh_axis_vocabulary(mesh_py_source: str) -> Set[str]:
-    """The axis names MESH_AXES declares, resolving AXIS_* constants."""
+    """The axis names a MESH_AXES tuple declares, resolving AXIS_*
+    constants. Kept for linting OTHER codebases' mesh modules; the
+    repo's own default vocabulary now comes from
+    :func:`default_mesh_vocabulary` (the plan, not source parsing)."""
     tree = ast.parse(mesh_py_source)
     consts: Dict[str, str] = {}
     vocab: Set[str] = set()
@@ -142,10 +146,11 @@ def mesh_axis_vocabulary(mesh_py_source: str) -> Set[str]:
 
 
 def default_mesh_vocabulary() -> Set[str]:
-    mesh_py = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "parallel", "mesh.py")
-    with open(mesh_py) as f:
-        return mesh_axis_vocabulary(f.read())
+    """TPU002's axis vocabulary, read from the ExecutionPlan (the
+    ROADMAP #5 fix: the linter used to re-parse parallel/mesh.py
+    source, a second source of truth that could silently drift)."""
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    return set(ExecutionPlan.axis_names())
 
 
 # ---------------------------------------------------------------------------

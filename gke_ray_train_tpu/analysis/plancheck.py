@@ -1,0 +1,338 @@
+"""shardlint level 4 — static ExecutionPlan verification (plancheck).
+
+PR 5 proved accelerator-free analysis catches real defects in *code*;
+this level applies the same discipline to *configuration*: every
+shipped config resolves to one :class:`~gke_ray_train_tpu.plan
+.ExecutionPlan`, and plancheck proves — with shape/divisibility
+arithmetic and ``jax.eval_shape``, no backend, no hardware — that the
+plan is runnable, portable, and consistent with every artifact that
+claims to describe it:
+
+========  ===========================================================
+rule      what it proves
+========  ===========================================================
+PLAN000   the config parses and every plan field validates
+PLAN001   topology feasibility: every mesh axis size tiles the chip
+          count of the declared v5e/v5p/cpu topology preset
+PLAN002   model-dim divisibility: every sharded dim (embed, heads,
+          mlp hidden, vocab, stacked-layer/pipe) divides the product
+          of the mesh axes its logical PartitionSpec names — via
+          ``jax.eval_shape`` over the real ``init_params``
+PLAN003   checkpoint portability: for each (save, restore) pair of
+          the fake-device topologies (cpu-4/8/16) the reshard-on-
+          restore path in ``ckpt/manager.py`` is well-formed — the
+          state's logical spec re-derives valid shardings on the
+          restore mesh (the static half of elastic resume, ROADMAP #1)
+PLAN004   cross-artifact identity: the ``tests/budgets/*.json``
+          preset a plan pins was recorded under that preset plan's
+          fingerprint (a stale budget is a lint failure, not a
+          silently-wrong gate); AOT sidecar keys embed the same
+          fingerprint by construction (``perf/cache.py``)
+PLAN005   dialect drift: every ExecutionPlan config key is in
+          ``config.py`` KNOWN_KEYS *and* declared PLAN_SCOPED, and
+          every PLAN_SCOPED key maps back to a plan field — a renamed
+          knob fails lint instead of being silently ignored
+========  ===========================================================
+
+Portability semantics (PLAN003): the *structural* axes (model,
+context, pipe — they change the compiled program and the logical
+layout) are kept; the data-parallel axes (data, fsdp) reflow to fill
+whatever chip count the restore pool offers, exactly how elastic
+resume re-derives shardings from the logical spec rather than the
+saved layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from gke_ray_train_tpu.plan import (
+    CONFIG_KEYS, COMPILE_RELEVANT_FIELDS, ExecutionPlan, PlanError)
+
+RULES = {
+    "PLAN000": "config unparseable or plan field invalid",
+    "PLAN001": "mesh axes cannot tile the declared topology",
+    "PLAN002": "sharded model dim does not divide its mesh axes",
+    "PLAN003": "save/restore topology pair has no valid reshard",
+    "PLAN004": "budget preset / plan fingerprint mismatch",
+    "PLAN005": "ExecutionPlan <-> KNOWN_KEYS drift",
+}
+
+# a smoke config trains the deterministic tiny model (the entry sizes
+# vocab to the tokenizer, >= 260) — plancheck uses the same floor so
+# divisibility verdicts match what the smoke run would compile
+_SMOKE_VOCAB = 260
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanFinding:
+    rule: str
+    field: str          # the offending field/key/pair, for the report
+    message: str
+    config: str = ""    # config path or label
+
+    def __str__(self) -> str:
+        where = f"{self.config}: " if self.config else ""
+        return f"{where}{self.rule} [{self.field}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# model resolution (static: no weights, no tokenizer, no hub)
+# ---------------------------------------------------------------------------
+
+def model_config_for(config: Mapping[str, Any], plan: ExecutionPlan):
+    """The ModelConfig a config would train, derived statically. Returns
+    None when the config names no model (plain mesh-only checks apply)."""
+    from gke_ray_train_tpu.models.config import preset_for_model_id, tiny
+    if config.get("SMOKE_TEST"):
+        # the smoke entry sizes depth to the RESOLVED pipe axis — a
+        # declared -1 (fill) must resolve the same way here, or a
+        # correct config draws a false divisibility finding
+        try:
+            pipe_depth = plan.resolved_sizes()["pipe"]
+        except ValueError:
+            pipe_depth = max(plan.pipe, 1)
+        return tiny(vocab_size=_SMOKE_VOCAB, max_seq_len=plan.max_seq_len,
+                    n_layers=max(2, pipe_depth * plan.pipe_virtual_stages))
+    model_id = config.get("MODEL_ID")
+    if model_id:
+        return preset_for_model_id(str(model_id))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-rule checks
+# ---------------------------------------------------------------------------
+
+def feasibility_findings(plan: ExecutionPlan, model_cfg=None,
+                         label: str = "") -> List[PlanFinding]:
+    """PLAN001 + PLAN002 on the plan's declared topology."""
+    out: List[PlanFinding] = []
+    for msg in plan.mesh_findings():
+        out.append(PlanFinding("PLAN001", "MESH_*", msg, label))
+    if out or model_cfg is None:
+        return out
+    for msg in plan.model_findings(model_cfg):
+        field = ("MAX_SEQ_LENGTH" if "max_seq_len" in msg else
+                 "MESH_MODEL" if "n_heads" in msg or "n_kv_heads" in msg
+                 else "MESH_*")
+        out.append(PlanFinding("PLAN002", field, msg, label))
+    return out
+
+
+def _portability_plan(plan: ExecutionPlan) -> ExecutionPlan:
+    """The reshard dialect: structural axes kept, dp axes reflowed."""
+    return dataclasses.replace(plan, data=1, fsdp=-1, num_slices=1)
+
+
+def portability_chip_counts(plan: ExecutionPlan) -> Dict[str, int]:
+    """The fake-device topologies a plan's checkpoints must port
+    across: HALF, the declared count, and DOUBLE — the elastic-resume
+    contract (ROADMAP #1: a 16-chip job degrades to 8 and recovers).
+    Scaled to the declared topology so a legitimately large
+    tensor-parallel plan is not judged against a 4-chip toy it will
+    never restore on (for the canonical cpu-8 plan this is exactly
+    fake-4/8/16)."""
+    n = plan.chips
+    return {f"fake-{c}": c
+            for c in sorted({max(n // 2, 1), n, n * 2})}
+
+
+def portability_findings(plan: ExecutionPlan, model_cfg=None,
+                         topologies: Optional[Mapping[str, int]] = None,
+                         label: str = "") -> List[PlanFinding]:
+    """PLAN003: the checkpoint-portability matrix. For each (save,
+    restore) topology pair, the restore side must re-derive valid
+    shardings from the SAME logical spec (shapes are topology-free by
+    construction — ``ckpt/manager.py::restore`` honors the target
+    template's shardings, so static validity of the template IS the
+    well-formedness of the reshard path)."""
+    port = _portability_plan(plan)
+    if topologies is None:
+        topologies = portability_chip_counts(plan)
+    verdicts: Dict[str, List[str]] = {}
+    for topo, chips in topologies.items():
+        verdicts[topo] = port.feasibility(model_cfg, chips)
+    out: List[PlanFinding] = []
+    for save in topologies:
+        if verdicts[save]:
+            continue                       # nothing savable to port
+        for restore in topologies:
+            if restore == save or not verdicts[restore]:
+                continue
+            out.append(PlanFinding(
+                "PLAN003", f"{save}->{restore}",
+                f"checkpoint saved on {save} has no valid reshard onto "
+                f"{restore}: {verdicts[restore][0]}", label))
+    return out
+
+
+def budget_findings(plan: ExecutionPlan, *,
+                    budget_dir: Optional[str] = None,
+                    label: str = "") -> List[PlanFinding]:
+    """PLAN004 for one plan: its pinned budget preset exists, is
+    recorded, and was recorded under the preset plan's fingerprint."""
+    if plan.budget_preset is None:
+        return []
+    from gke_ray_train_tpu.perf.budget import (
+        PRESETS, budget_path, load_budget, plan_for_preset)
+    name = plan.budget_preset
+    if name not in PRESETS:
+        return [PlanFinding(
+            "PLAN004", "BUDGET_PRESET",
+            f"unknown budget preset {name!r}; known: {sorted(PRESETS)}",
+            label)]
+    path = budget_path(name, budget_dir)
+    if not os.path.exists(path):
+        return [PlanFinding(
+            "PLAN004", "BUDGET_PRESET",
+            f"no recorded budget at {path} — run: python -m "
+            "gke_ray_train_tpu.perf.budget record", label)]
+    doc = load_budget(path)
+    preset_plan = plan_for_preset(name)
+    want = preset_plan.fingerprint()
+    have = doc.get("_plan_fingerprint")
+    if have != want:
+        return [PlanFinding(
+            "PLAN004", "BUDGET_PRESET",
+            f"budget {path} was recorded under plan {have or '<none>'} "
+            f"but preset {name!r} now resolves to plan {want} — stale "
+            "budget; re-record and review the diff", label)]
+    # the pinned budget only describes THIS run if the compile-relevant
+    # plan fields agree — comparing a differently-meshed/batched step
+    # against it would report drift that is really apples-to-oranges.
+    # Mesh axes compare RESOLVED (a -1 fill that lands on the preset's
+    # size is the same compiled program, not a mismatch).
+    from gke_ray_train_tpu.plan import CHIP_COUNTS
+    mesh_axes = ("data", "fsdp", "model", "context", "pipe")
+    try:
+        run_sizes = plan.resolved_sizes(CHIP_COUNTS[preset_plan.topology])
+    except ValueError as e:
+        return [PlanFinding(
+            "PLAN004", "BUDGET_PRESET",
+            f"plan pins budget preset {name!r} but cannot tile its "
+            f"canonical {preset_plan.topology} mesh: {e}", label)]
+    want_sizes = preset_plan.resolved_sizes()
+    diff = {a: (run_sizes[a], want_sizes[a]) for a in mesh_axes
+            if run_sizes[a] != want_sizes[a]}
+    diff.update({f: (getattr(plan, f), getattr(preset_plan, f))
+                 for f in COMPILE_RELEVANT_FIELDS if f not in mesh_axes
+                 and getattr(plan, f) != getattr(preset_plan, f)})
+    if diff:
+        detail = ", ".join(f"{f}: {a} vs preset {b}"
+                           for f, (a, b) in sorted(diff.items()))
+        return [PlanFinding(
+            "PLAN004", "BUDGET_PRESET",
+            f"plan {plan.fingerprint()} pins budget preset {name!r} "
+            f"(plan {want}) but differs on compile-relevant fields "
+            f"({detail}) — the budget cannot describe this step", label)]
+    return []
+
+
+def repo_budget_findings(budget_dir: Optional[str] = None
+                         ) -> List[PlanFinding]:
+    """PLAN004, repo level: every checked-in budget JSON matches the
+    fingerprint of the preset plan that would re-record it."""
+    from gke_ray_train_tpu.perf.budget import (
+        BUDGET_DIR, PRESETS, budget_path, load_budget, plan_for_preset)
+    out: List[PlanFinding] = []
+    bdir = budget_dir or BUDGET_DIR
+    for name in sorted(PRESETS):
+        path = budget_path(name, bdir)
+        if not os.path.exists(path):
+            continue   # unrecorded presets are perf.budget's business
+        doc = load_budget(path)
+        want = plan_for_preset(name).fingerprint()
+        have = doc.get("_plan_fingerprint")
+        if have != want:
+            out.append(PlanFinding(
+                "PLAN004", name,
+                f"budget {path} records plan {have or '<none>'} but "
+                f"preset {name!r} resolves to plan {want} — stale "
+                "budget (re-record and review the diff like code)",
+                "tests/budgets"))
+    return out
+
+
+def drift_findings() -> List[PlanFinding]:
+    """PLAN005: the plan's config-key mapping, config.py KNOWN_KEYS and
+    the PLAN_SCOPED_KEYS declaration agree in both directions."""
+    from gke_ray_train_tpu.config import KNOWN_KEYS, PLAN_SCOPED_KEYS
+    plan_keys = set(CONFIG_KEYS.values())
+    out: List[PlanFinding] = []
+    for key in sorted(plan_keys - set(KNOWN_KEYS)):
+        out.append(PlanFinding(
+            "PLAN005", key,
+            "ExecutionPlan maps a field to this config key but "
+            "config.py KNOWN_KEYS does not list it — the key would be "
+            "warned about as unknown and silently ignored", "config.py"))
+    for key in sorted(plan_keys - set(PLAN_SCOPED_KEYS)):
+        out.append(PlanFinding(
+            "PLAN005", key,
+            "ExecutionPlan owns this config key but config.py does not "
+            "declare it PLAN_SCOPED — add it to PLAN_SCOPED_KEYS",
+            "config.py"))
+    for key in sorted(set(PLAN_SCOPED_KEYS) - plan_keys):
+        out.append(PlanFinding(
+            "PLAN005", key,
+            "config.py declares this key plan-scoped but no "
+            "ExecutionPlan field maps to it — the plan and the config "
+            "surface have diverged", "config.py"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# whole-config / whole-repo entry points
+# ---------------------------------------------------------------------------
+
+def check_config(config: Mapping[str, Any], *, label: str = "",
+                 budget_dir: Optional[str] = None) -> List[PlanFinding]:
+    """All per-config findings (PLAN000-PLAN004) for one flat config."""
+    try:
+        plan = ExecutionPlan.from_config(config)
+    except PlanError as e:
+        return [PlanFinding("PLAN000", "plan", str(e), label)]
+    try:
+        model_cfg = model_config_for(config, plan)
+    except ValueError as e:
+        return [PlanFinding("PLAN000", "MODEL_ID", str(e), label)]
+    out = feasibility_findings(plan, model_cfg, label=label)
+    out.extend(portability_findings(plan, model_cfg, label=label))
+    out.extend(budget_findings(plan, budget_dir=budget_dir, label=label))
+    return out
+
+
+def check_config_file(path: str, *, budget_dir: Optional[str] = None
+                      ) -> List[PlanFinding]:
+    label = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            config = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return [PlanFinding("PLAN000", "config",
+                            f"unreadable config: {e}", label)]
+    return check_config(config, label=label, budget_dir=budget_dir)
+
+
+def default_config_paths(repo_root: str) -> List[str]:
+    """The shipped configs plancheck gates: every fine-tune preset JSON
+    (they declare their v5e/v5p topology via the TOPOLOGY key)."""
+    import glob
+    return sorted(glob.glob(os.path.join(
+        repo_root, "ray-jobs", "fine_tune_config*.json")))
+
+
+def check_paths(paths: List[str], *, budget_dir: Optional[str] = None
+                ) -> List[PlanFinding]:
+    """The CLI body: per-config checks plus the repo-level consistency
+    rules (budget fingerprints, KNOWN_KEYS drift) that hold regardless
+    of which config is being trained."""
+    findings: List[PlanFinding] = []
+    for p in paths:
+        findings.extend(check_config_file(p, budget_dir=budget_dir))
+    findings.extend(repo_budget_findings(budget_dir))
+    findings.extend(drift_findings())
+    return findings

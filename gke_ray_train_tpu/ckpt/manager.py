@@ -76,6 +76,29 @@ class CheckpointManager:
         return self._mgr.restore(step,
                                  args=ocp.args.StandardRestore(abstract))
 
+    def restore_resharded(self, state_like: Any, mesh, spec_tree: Any,
+                          step: Optional[int] = None) -> Any:
+        """Cross-topology restore: re-derive shardings from the LOGICAL
+        PartitionSpec tree on a NEW mesh instead of reusing the saved
+        layout — the reshard-on-restore path of elastic resume (ROADMAP
+        #1: save on a 16-chip mesh, restore on 8). ``spec_tree`` must be
+        structurally isomorphic to ``state_like`` (params:
+        ``models.transformer.param_specs``; optimizer state:
+        ``train.step.opt_state_specs``; an ExecutionPlan supplies the
+        batch/mesh side). ``analysis plancheck`` (PLAN003) statically
+        proves every (save, restore) topology pair this path will be
+        asked to handle is well-formed — same logical shapes, valid
+        shardings on the restore mesh."""
+        from jax.sharding import NamedSharding
+
+        abstract = jax.tree.map(
+            lambda leaf, spec: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype,
+                sharding=NamedSharding(mesh, spec)),
+            jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like),
+            spec_tree)
+        return self.restore(abstract, step=step)
+
     def restore_raw(self, step: Optional[int] = None) -> Any:
         """Topology-free restore: structure/shapes come from checkpoint
         metadata, everything lands on this host's first device — the

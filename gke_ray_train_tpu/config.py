@@ -23,8 +23,35 @@ from gke_ray_train_tpu.train.optim import make_optimizer, \
 
 logger = logging.getLogger(__name__)
 
+# config keys owned by the declarative ExecutionPlan (plan.py): mesh
+# topology, batch shape, donation, input pipeline, compile-once policy,
+# runtime guards, identity. Declared here so the plan <-> config-surface
+# contract is checkable: plancheck rule PLAN005 asserts this set equals
+# plan.CONFIG_KEYS.values() exactly (and that it is a KNOWN_KEYS
+# subset) — a knob renamed on either side fails lint instead of being
+# silently ignored.
+PLAN_SCOPED_KEYS = frozenset({
+    # mesh topology
+    "MESH_DATA", "MESH_FSDP", "MESH_MODEL", "MESH_CONTEXT", "MESH_PIPE",
+    "NUM_SLICES", "PIPE_MICROBATCHES", "PIPE_VIRTUAL_STAGES",
+    # batch shape the step compiles against
+    "PER_DEVICE_TRAIN_BATCH_SIZE", "GRADIENT_ACCUMULATION_STEPS",
+    "MAX_SEQ_LENGTH", "PACKING",
+    # donation policy
+    "DONATE_STATE", "DONATE_BATCH",
+    # input pipeline
+    "PREFETCH_BATCHES",
+    # compile-once policy (perf/cache.py)
+    "COMPILE_CACHE", "COMPILE_CACHE_DIR", "AOT_TRAIN_STEP",
+    # runtime guards (analysis/guards.py)
+    "TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
+    # identity: declared chip topology + pinned cost budget
+    "TOPOLOGY", "BUDGET_PRESET",
+})
+
 # every key the fine-tune entry point honors (reference keys + mesh/TPU
 # extensions). Keys present in a config but not listed here draw a warning.
+# Plan-scoped keys are unioned in below (one declaration, no drift).
 KNOWN_KEYS = frozenset({
     # model / data / output
     "MODEL_ID", "DATASET_NAME", "OUTPUT_DIR_BASE",
@@ -37,36 +64,22 @@ KNOWN_KEYS = frozenset({
     "LLAMA_TARGET_MODULES", "QUANT_KIND",
     "BNB_4BIT_COMPUTE_DTYPE", "BNB_4BIT_QUANT_TYPE", "USE_NESTED_QUANT",
     # optimization
-    "NUM_TRAIN_EPOCHS", "PER_DEVICE_TRAIN_BATCH_SIZE",
-    "GRADIENT_ACCUMULATION_STEPS", "LEARNING_RATE", "WEIGHT_DECAY",
+    "NUM_TRAIN_EPOCHS", "LEARNING_RATE", "WEIGHT_DECAY",
     "OPTIM", "LR_SCHEDULER_TYPE", "MAX_GRAD_NORM", "WARMUP_RATIO",
     # cadences / reporting
     "LOGGING_STEPS", "SAVE_STRATEGY", "SAVE_STEPS_SFT",
     "EVALUATION_STRATEGY_SFT", "EVAL_STEPS_SFT", "REPORT_TO",
-    # sequence handling
-    "MAX_SEQ_LENGTH", "PACKING", "GROUP_BY_LENGTH",
-    # input pipeline (data/prefetch.py): queue depth of the background
-    # prefetch+placement thread; 0 = synchronous
-    "PREFETCH_BATCHES",
-    # compile-once layer (perf/cache.py): persistent XLA cache dir on
-    # shared storage, and the AOT train-step executable persisted
-    # beside the checkpoint (1/default = on)
-    "COMPILE_CACHE_DIR", "AOT_TRAIN_STEP",
-    # shardlint runtime guards (analysis/guards.py): d2h transfer guard
-    # around the hot loop (log|disallow), hard compile-count limit per
-    # step fn, multi-host lowered-HLO divergence check at attempt start
-    "TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
+    # sequence handling (MAX_SEQ_LENGTH/PACKING are plan-scoped)
+    "GROUP_BY_LENGTH",
     # inference comparison
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",
-    # TPU / mesh extensions
+    # TPU / model-numerics extensions (the plan owns the mesh keys)
     "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
-    "MESH_DATA", "MESH_FSDP",
-    "MESH_MODEL", "MESH_CONTEXT", "MESH_PIPE", "PIPE_MICROBATCHES",
-    "PIPE_VIRTUAL_STAGES", "NUM_SLICES", "SMOKE_TEST",
+    "SMOKE_TEST",
     # profiling / debug (train/profiling.py)
     "PROFILE", "PROFILE_START_STEP", "PROFILE_NUM_STEPS", "DEBUG_NANS",
-})
+}) | PLAN_SCOPED_KEYS
 
 
 def audit_config(config: dict, *, known=KNOWN_KEYS,

@@ -58,14 +58,22 @@ def load_budget(path: str) -> Dict[str, Any]:
 
 
 def write_budget(report: Union[StepCostReport, Dict[str, Any]], path: str,
-                 *, preset: str = "", note: str = "") -> Dict[str, Any]:
+                 *, preset: str = "", note: str = "",
+                 plan=None) -> Dict[str, Any]:
     if isinstance(report, StepCostReport):
         report = report.to_dict()
     import jax
+    if plan is None and preset in PRESETS:
+        plan = plan_for_preset(preset)
     doc = {
         "_preset": preset,
         "_note": note or ("re-baseline with: python -m "
                           "gke_ray_train_tpu.perf.budget record"),
+        # the ExecutionPlan identity this budget was recorded under
+        # (plan.py): plancheck PLAN004 fails the build when the preset
+        # plan no longer resolves to this fingerprint (stale budget)
+        "_plan_fingerprint": plan.fingerprint() if plan is not None
+        else None,
         "_recorded_with": {"jax": jax.__version__,
                            "platform": jax.devices()[0].platform,
                            "n_devices": len(jax.devices())},
@@ -155,12 +163,25 @@ def _hlo_delta(have_lines: List[str], want_lines: List[str],
 
 
 def assert_within_budget(report: Union[StepCostReport, Dict[str, Any]],
-                         budget_path: str, **kw) -> None:
-    viols = compare_to_budget(report, load_budget(budget_path), **kw)
+                         budget_path: str, *, plan=None, **kw) -> None:
+    """Raise :class:`BudgetViolation` on any comparator finding. The
+    failure names the preset AND the plan fingerprint the budget was
+    recorded under (plus the current plan's, when given) — a mismatched
+    budget used to print only HLO deltas, leaving WHICH declared plan
+    drifted to archaeology."""
+    budget = load_budget(budget_path)
+    viols = compare_to_budget(report, budget, **kw)
     if viols:
+        preset = budget.get("_preset") or os.path.splitext(
+            os.path.basename(budget_path))[0]
+        recorded_fp = budget.get("_plan_fingerprint") or "<unrecorded>"
+        ident = f"preset {preset!r} (recorded under plan {recorded_fp}"
+        if plan is not None:
+            ident += f"; current plan {plan.fingerprint()}"
+        ident += ")"
         raise BudgetViolation(
-            f"compiled step broke the budget {budget_path}:\n  "
-            + "\n  ".join(viols)
+            f"compiled step broke the budget {budget_path} — {ident}:"
+            "\n  " + "\n  ".join(viols)
             + "\nIf the change is INTENTIONAL, re-baseline: python -m "
               "gke_ray_train_tpu.perf.budget record")
 
@@ -186,6 +207,29 @@ PRESETS = {
 }
 
 
+def plan_for_preset(preset: Union[str, "Preset"]):
+    """The ExecutionPlan a budget preset measures under — the SAME plan
+    object ``analysis check`` and the budget CLI consume, so one
+    fingerprint identifies the preset across budget JSONs, plancheck,
+    and the comparator's failure output.
+
+    Measurement policy is part of the identity: budgets are recorded
+    donate=False (backend-independent numbers) with no input pipeline
+    or guards, on the canonical 8-fake-device CPU mesh."""
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    p = PRESETS[preset] if isinstance(preset, str) else preset
+    mesh = {axis: p.mesh.get(axis, 1)
+            for axis in ("data", "fsdp", "model", "context", "pipe")}
+    dp = mesh["data"] * mesh["fsdp"]
+    return ExecutionPlan.from_kwargs(
+        **mesh,
+        per_device_batch=max(p.batch // max(dp, 1), 1),
+        grad_accum=1, max_seq_len=p.seq, packing=False,
+        donate_state=False, donate_batch=False,
+        prefetch=0, compile_cache=False, aot_train_step=False,
+        topology="cpu-8", budget_preset=p.name)
+
+
 def build_preset_step(preset: Union[str, Preset], *, remat=None,
                       wrap=None, donate: bool = False,
                       with_jitted: bool = False):
@@ -200,33 +244,35 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
     ``with_jitted``: return (compiled, state, batch, jitted_step) — the
     analysis compile-once check dispatches the JITTED fn (the compiled
     executable can trivially never recompile)."""
+    import dataclasses as _dc
+
     import jax
     import jax.numpy as jnp
 
     from gke_ray_train_tpu.models import tiny
-    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
     from gke_ray_train_tpu.train import (
         make_optimizer, make_train_state, make_train_step)
-    from gke_ray_train_tpu.train.step import batch_shardings
 
     p = PRESETS[preset] if isinstance(preset, str) else preset
-    mesh = build_mesh(MeshConfig(**p.mesh), jax.devices())
+    # ONE ExecutionPlan drives mesh, batch shardings and donation — the
+    # same plan object whose fingerprint the budget JSON records
+    plan = _dc.replace(plan_for_preset(p), donate_state=donate)
+    mesh = plan.build_mesh(jax.devices())
     cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=128,
                vocab_size=256, max_seq_len=p.seq,
                remat=p.remat if remat is None else remat)
     opt = make_optimizer(1e-3)
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
-    # donate=False default: budgets must not vary with backend donation
-    # support (the analysis donation check opts in explicitly)
-    step = make_train_step(cfg, opt, mesh=mesh, donate=donate,
-                           donate_batch=False)
+    # donate_state=False default: budgets must not vary with backend
+    # donation support (the analysis donation check opts in explicitly)
+    step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
     if wrap is not None:
         step = jax.jit(wrap(step.__wrapped__))
     batch = jax.device_put(
         {"inputs": jnp.zeros((p.batch, p.seq), jnp.int32),
          "targets": jnp.zeros((p.batch, p.seq), jnp.int32),
          "weights": jnp.ones((p.batch, p.seq), jnp.float32)},
-        batch_shardings(mesh))
+        plan.batch_shardings(mesh))
     compiled = step.lower(state, batch).compile()
     if with_jitted:
         return compiled, state, batch, step
@@ -280,15 +326,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     names = args.names or sorted(PRESETS)
     rc = 0
     for name in names:
+        plan = plan_for_preset(name)
         report = build_preset_report(name)
         path = budget_path(name, args.dir)
         if args.command == "record":
-            write_budget(report, path, preset=name)
-            print(f"recorded {path}")
+            write_budget(report, path, preset=name, plan=plan)
+            print(f"recorded {path} (plan {plan.fingerprint()})")
         else:
             try:
-                assert_within_budget(report, path)
-                print(f"{name}: within budget")
+                assert_within_budget(report, path, plan=plan)
+                print(f"{name}: within budget "
+                      f"(plan {plan.fingerprint()})")
             except BudgetViolation as e:
                 print(e)
                 rc = 1

@@ -157,12 +157,19 @@ def topology_fingerprint() -> Tuple[str, Dict[str, Any]]:
     return digest, facts
 
 
-def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            plan=None) -> Optional[str]:
     """Point JAX's persistent compilation cache at shared storage.
 
-    Resolution: explicit arg → ``$COMPILE_CACHE_DIR`` → the PVC default
-    ``/mnt/pvc/xla_cache``; the actual cache lives in a
-    topology-fingerprint subdir. ``COMPILE_CACHE=0`` disables.
+    Resolution: explicit arg → ``plan.compile_cache_dir`` →
+    ``$COMPILE_CACHE_DIR`` → the PVC default ``/mnt/pvc/xla_cache``;
+    the actual cache lives in a topology-fingerprint subdir (suffixed
+    with the ExecutionPlan's COMPILE fingerprint when a plan is given —
+    the plan identity subsumes the bare topology fingerprint, so two
+    runs share a subdir only when both the hardware AND the declared
+    compiled program agree; operational knobs like prefetch depth or a
+    guard do not split the cache).
+    ``COMPILE_CACHE=0`` (or ``plan.compile_cache=False``) disables.
     Unwritable dirs fall back to ``~/.cache/gke_ray_train_tpu`` and
     then to disabled — never raise.
 
@@ -173,12 +180,19 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     Returns the resolved cache dir, or None when disabled.
     """
     global _ENABLED_DIR
+    if plan is not None and not plan.compile_cache:
+        logger.info("compile cache disabled by the execution plan "
+                    "(COMPILE_CACHE=0)")
+        return None
     if os.environ.get("COMPILE_CACHE", "1").lower() in ("0", "false"):
         logger.info("compile cache disabled via COMPILE_CACHE=0")
         return None
-    base = cache_dir or os.environ.get("COMPILE_CACHE_DIR",
-                                       DEFAULT_CACHE_DIR)
+    base = cache_dir \
+        or (plan.compile_cache_dir if plan is not None else None) \
+        or os.environ.get("COMPILE_CACHE_DIR", DEFAULT_CACHE_DIR)
     digest, facts = topology_fingerprint()
+    if plan is not None:
+        digest = f"{digest}-{plan.compile_fingerprint()[:8]}"
     resolved = None
     for candidate in (os.path.join(base, digest),
                       os.path.join(_LOCAL_FALLBACK, digest)):
@@ -229,8 +243,10 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 # ---------------------------------------------------------------------------
 
 def aot_enabled(config: Optional[dict] = None) -> bool:
-    """The AOT_TRAIN_STEP knob, one parse for every entry script:
-    config key wins over env; default on."""
+    """Legacy parse of the AOT_TRAIN_STEP knob (config key wins over
+    env; default on). The entry scripts now read it from
+    ``ExecutionPlan.aot_train_step`` (plan.py) via
+    ``compile_step_with_plan`` — kept for ad-hoc callers."""
     if config is not None and "AOT_TRAIN_STEP" in config:
         raw = config["AOT_TRAIN_STEP"]
     else:
@@ -267,14 +283,18 @@ def _leaf_signature(leaf: Any) -> tuple:
     return (shape, dtype, repr(spec) if spec is not None else None)
 
 
-def aot_signature(*args_trees: Any) -> str:
+def aot_signature(*args_trees: Any, plan=None) -> str:
     """Digest of the abstract input signature (treedef + per-leaf
-    shape/dtype/partition-spec) + topology fingerprint — the validity
-    key of a serialized executable. A sidecar whose key mismatches is
-    stale (different mesh, model size, batch layout, chip) and is
-    ignored rather than loaded."""
+    shape/dtype/partition-spec) + topology fingerprint + (when given)
+    the ExecutionPlan's COMPILE fingerprint — the validity key of a
+    serialized executable. A sidecar whose key mismatches is stale
+    (different mesh, model size, batch layout, chip, or a plan that
+    compiles a different program) and is ignored rather than loaded;
+    operational plan knobs deliberately do NOT invalidate it."""
     leaves, treedef = jax.tree.flatten(args_trees)
-    payload = (topology_fingerprint()[0], str(treedef),
+    payload = (topology_fingerprint()[0],
+               plan.compile_fingerprint() if plan is not None else None,
+               str(treedef),
                [_leaf_signature(x) for x in leaves])
     return hashlib.sha256(repr(payload).encode()).hexdigest()
 
@@ -376,7 +396,8 @@ class GuardedStep:
 
 def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
                        sidecar: Optional[str] = None,
-                       label: str = "train_step") -> GuardedStep:
+                       label: str = "train_step",
+                       plan=None) -> GuardedStep:
     """AOT-build a jitted step (or deserialize its sidecar) and return a
     :class:`GuardedStep`.
 
@@ -390,8 +411,10 @@ def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
       lives on shared storage.
     """
     args = tuple(abstractify(a) for a in abstract_args)
-    key = aot_signature(*args)
+    key = aot_signature(*args, plan=plan)
     info: Dict[str, Any] = {"label": label, "sidecar": sidecar}
+    if plan is not None:
+        info["plan_fingerprint"] = plan.fingerprint()
     if sidecar:
         t0 = time.perf_counter()
         loaded = load_executable(sidecar, key)

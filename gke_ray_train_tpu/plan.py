@@ -1,0 +1,566 @@
+"""ExecutionPlan — one declarative, validated plan object (ROADMAP #5).
+
+The knobs that shape a run's *execution* (as opposed to its data or
+optimization hyperparameters) historically lived in four dialects:
+
+1. flat UPPER_CASE JSON config keys (``config.py`` KNOWN_KEYS),
+2. env vars forwarded to Ray workers by the trainer,
+3. ``run_training(...)`` / ``make_train_step(...)`` kwargs,
+4. per-preset budget JSONs (``tests/budgets/*.json``).
+
+:class:`ExecutionPlan` collapses them: one frozen dataclass holding the
+mesh axes + sizes, the logical PartitionSpecs for params/optimizer/batch
+(delegated to the canonical tables in ``models/transformer.py`` /
+``train/step.py`` so specs can never fork), the donation policy, the
+AOT/compile-cache policy, the runtime guards, and the budget preset —
+with a constructor per legacy dialect (:meth:`from_config`,
+:meth:`from_env`, :meth:`from_kwargs`) that produces an IDENTICAL plan
+(and fingerprint) for identical settings.
+
+``fingerprint()`` is the plan's stable identity: a digest of the
+canonical field dict, independent of process, host, and backend. It is
+recorded in budget JSONs (``_plan_fingerprint``), BENCH records, and
+AOT sidecar keys (``perf/cache.py`` composes it with the runtime
+topology fingerprint, which it thereby subsumes: two runs share a
+compiled artifact only when both the physical topology AND the declared
+plan agree).
+
+Everything here is statically checkable with no accelerator —
+``analysis/plancheck.py`` verifies feasibility/portability/consistency
+on the same CPU-only CI runner that runs shardlint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+from gke_ray_train_tpu.parallel.mesh import MESH_AXES, BATCH_AXES, MeshConfig
+
+
+class PlanError(ValueError):
+    """An ExecutionPlan field failed validation."""
+
+
+# chip counts of the topology presets plancheck verifies against. The
+# real accelerator backend being dark (ROADMAP preamble), these are
+# *declared* shapes — the point is that every one of them is checkable
+# via shape/divisibility arithmetic with zero hardware. cpu-N are the
+# fake-device CI meshes (save-on-8 → restore-on-4/16 is the static half
+# of elastic resume, ROADMAP #1).
+CHIP_COUNTS: Dict[str, int] = {
+    "cpu-4": 4, "cpu-8": 8, "cpu-16": 16,
+    "v5e-4": 4, "v5e-8": 8, "v5e-16": 16, "v5e-32": 32, "v5e-64": 64,
+    "v5p-8": 8, "v5p-16": 16, "v5p-32": 32, "v5p-64": 64, "v5p-128": 128,
+}
+
+_TRANSFER_GUARD_MODES = (None, "log", "disallow")
+
+
+def _as_bool(v: Any, field: str) -> bool:
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, (int, float)):
+        return bool(v)
+    s = str(v).strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off", ""):
+        return False
+    raise PlanError(f"{field}={v!r} is not a boolean")
+
+
+def _as_int(v: Any, field: str) -> int:
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise PlanError(f"{field}={v!r} is not an int") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutionPlan:
+    """The one declarative execution plan. Frozen and hashable by
+    fingerprint; every field maps 1:1 to a flat config key
+    (:data:`CONFIG_KEYS`) — plancheck PLAN005 keeps that mapping and
+    ``config.py`` KNOWN_KEYS from drifting."""
+
+    # -- mesh topology (MeshConfig dialect; -1 = fill) ------------------
+    data: int = 1
+    fsdp: int = -1
+    model: int = 1
+    context: int = 1
+    pipe: int = 1
+    num_slices: int = 1
+    pipe_microbatches: int = 0          # 0 = default (one per stage)
+    pipe_virtual_stages: int = 1
+
+    # -- batch shape the step compiles against --------------------------
+    per_device_batch: int = 2
+    grad_accum: int = 1
+    max_seq_len: int = 1024
+    packing: bool = False
+
+    # -- donation policy ------------------------------------------------
+    donate_state: bool = True
+    donate_batch: bool = True
+
+    # -- input pipeline --------------------------------------------------
+    prefetch: int = 2
+
+    # -- compile-once policy (perf/cache.py) ----------------------------
+    compile_cache: bool = True
+    compile_cache_dir: Optional[str] = None   # None = perf.cache default
+    aot_train_step: bool = True
+
+    # -- runtime guards (analysis/guards.py) ----------------------------
+    transfer_guard: Optional[str] = None      # None | "log" | "disallow"
+    recompile_limit: int = 0                  # 0 = off
+    divergence_guard: bool = False
+
+    # -- identity --------------------------------------------------------
+    topology: str = "cpu-8"                   # key into CHIP_COUNTS
+    budget_preset: Optional[str] = None       # tests/budgets/<name>.json
+
+    def __post_init__(self):
+        for axis in MESH_AXES:
+            v = getattr(self, axis)
+            if v != -1 and v < 1:
+                raise PlanError(
+                    f"mesh axis {axis}={v} must be >= 1 (or -1 to fill)")
+        if self.num_slices < 1:
+            raise PlanError(f"num_slices={self.num_slices} must be >= 1")
+        for field in ("per_device_batch", "grad_accum", "max_seq_len",
+                      "pipe_virtual_stages"):
+            if getattr(self, field) < 1:
+                raise PlanError(f"{field}={getattr(self, field)} must "
+                                "be >= 1")
+        for field in ("prefetch", "recompile_limit", "pipe_microbatches"):
+            if getattr(self, field) < 0:
+                raise PlanError(f"{field}={getattr(self, field)} must "
+                                "be >= 0")
+        if self.transfer_guard not in _TRANSFER_GUARD_MODES:
+            raise PlanError(
+                f"transfer_guard={self.transfer_guard!r} not in "
+                f"{_TRANSFER_GUARD_MODES}")
+        if self.topology not in CHIP_COUNTS:
+            raise PlanError(f"topology={self.topology!r} unknown; "
+                            f"presets: {sorted(CHIP_COUNTS)}")
+
+    # ------------------------------------------------------------------
+    # dialect constructors
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def axis_names() -> Tuple[str, ...]:
+        """The mesh-axis vocabulary — the single source shardlint TPU002
+        reads (it used to parse ``parallel/mesh.py`` source)."""
+        return tuple(MESH_AXES)
+
+    @classmethod
+    def from_config(cls, config: Mapping[str, Any]) -> "ExecutionPlan":
+        """Build from the flat UPPER_CASE dialect (fine_tune_config.json
+        / env-var strings). Unknown keys are ignored here — ``config.py
+        audit_config`` owns unknown-key warnings; plancheck PLAN005 owns
+        plan↔KNOWN_KEYS drift."""
+        kw: Dict[str, Any] = {}
+        for field, key in CONFIG_KEYS.items():
+            if key in config and config[key] is not None:
+                kw[field] = _coerce(field, config[key])
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping[str, str]] = None
+                 ) -> "ExecutionPlan":
+        """Build from environment variables (the dialect the trainer
+        forwards to Ray workers). Same keys as the JSON dialect."""
+        return cls.from_config(dict(env if env is not None
+                                    else os.environ))
+
+    @classmethod
+    def resolve(cls, config: Optional[Mapping[str, Any]] = None,
+                env: Optional[Mapping[str, str]] = None,
+                **overrides: Any) -> "ExecutionPlan":
+        """The runtime constructor: env dialect overlaid by the config
+        dialect (config key wins — the same precedence every legacy
+        knob had), then pythonic kwarg overrides. This is what the
+        trainer and both entry points call, so the plan a worker runs
+        is derived from exactly the sources the legacy dialects read."""
+        merged: Dict[str, Any] = dict(env if env is not None
+                                      else os.environ)
+        for k, v in (config or {}).items():
+            if v is not None:
+                merged[k] = v
+        plan = cls.from_config(merged)
+        if overrides:
+            plan = dataclasses.replace(
+                plan, **{k: _coerce(k, v) for k, v in overrides.items()})
+        return plan
+
+    @classmethod
+    def from_kwargs(cls, **kwargs: Any) -> "ExecutionPlan":
+        """Build from pythonic field names (the ``run_training`` /
+        ``make_train_step`` kwargs dialect)."""
+        unknown = sorted(set(kwargs) - {f.name for f in
+                                        dataclasses.fields(cls)})
+        if unknown:
+            raise PlanError(f"unknown plan fields {unknown}; valid: "
+                            f"{sorted(f.name for f in dataclasses.fields(cls))}")
+        return cls(**{k: _coerce(k, v) for k, v in kwargs.items()})
+
+    def to_config(self) -> Dict[str, Any]:
+        """The plan in the flat UPPER_CASE dialect (round-trips through
+        :meth:`from_config`)."""
+        return {key: getattr(self, field)
+                for field, key in CONFIG_KEYS.items()}
+
+    # ------------------------------------------------------------------
+    # identity
+    # ------------------------------------------------------------------
+
+    def canonical(self) -> Dict[str, Any]:
+        """JSON-safe canonical field dict — the fingerprint payload."""
+        return {f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)}
+
+    def fingerprint(self) -> str:
+        """Stable 16-hex-char identity of the declared plan — every
+        field. Recorded in budget JSONs, BENCH records, attempt logs."""
+        return hashlib.sha256(
+            json.dumps(self.canonical(), sort_keys=True).encode()
+        ).hexdigest()[:16]
+
+    def compile_fingerprint(self) -> str:
+        """Identity of the COMPILED PROGRAM the plan implies: only the
+        fields that change what XLA builds (:data:`COMPILE_RELEVANT_
+        FIELDS`). This is what AOT sidecar keys and compile-cache
+        subdirs embed (composed with the runtime topology fingerprint,
+        which supplies device kind/count) — toggling an operational
+        knob (prefetch depth, a guard, the cache dir itself) must NOT
+        invalidate a bitwise-identical executable."""
+        payload = {f: getattr(self, f) for f in COMPILE_RELEVANT_FIELDS}
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------------------
+    # derived topology / shardings
+    # ------------------------------------------------------------------
+
+    @property
+    def chips(self) -> int:
+        return CHIP_COUNTS[self.topology]
+
+    def mesh_config(self) -> MeshConfig:
+        return MeshConfig(data=self.data, fsdp=self.fsdp, model=self.model,
+                          context=self.context, pipe=self.pipe,
+                          num_slices=self.num_slices)
+
+    def resolved_sizes(self, n_chips: Optional[int] = None
+                       ) -> Dict[str, int]:
+        """Mesh axis sizes with -1 resolved against ``n_chips`` (default:
+        the declared topology's chip count). Raises ValueError when the
+        plan cannot tile that chip count."""
+        resolved = self.mesh_config().resolve(
+            self.chips if n_chips is None else n_chips)
+        return {axis: getattr(resolved, axis) for axis in MESH_AXES}
+
+    def build_mesh(self, devices=None):
+        """The concrete device mesh (the one runtime-facing method)."""
+        from gke_ray_train_tpu.parallel.mesh import build_mesh
+        return build_mesh(self.mesh_config(), devices)
+
+    @property
+    def context_sharded(self) -> bool:
+        """Whether batch sequences shard over the context axis. A
+        declared ``-1`` (fill) is resolved against the declared
+        topology first — the DECLARED value alone would report
+        unsharded for a context axis that fills to >1."""
+        if self.context == -1:
+            try:
+                return self.resolved_sizes()["context"] > 1
+            except ValueError:
+                return True   # unresolvable fill: assume sharded
+        return self.context > 1
+
+    def batch_spec(self):
+        """Logical PartitionSpec of a [batch, seq, ...] array."""
+        from jax.sharding import PartitionSpec as P
+        return P(BATCH_AXES,
+                 "context" if self.context_sharded else None)
+
+    def batch_keys(self) -> Tuple[str, ...]:
+        return ("inputs", "targets", "weights") + (
+            ("segment_ids", "positions") if self.packing else ())
+
+    def batch_shardings(self, mesh) -> Dict[str, Any]:
+        from gke_ray_train_tpu.train.step import batch_shardings
+        return batch_shardings(mesh, self.batch_keys(),
+                               context_sharded=self.context_sharded)
+
+    def logical_param_specs(self, model_cfg) -> Any:
+        """The canonical per-leaf PartitionSpec tree (delegates to
+        ``models/transformer.py`` — the plan exposes, never forks, the
+        logical spec)."""
+        from gke_ray_train_tpu.models.transformer import param_specs
+        return param_specs(model_cfg)
+
+    def abstract_params(self, model_cfg) -> Any:
+        """Shape/dtype pytree of the params via ``jax.eval_shape`` —
+        no weights materialized, no backend touched."""
+        import jax
+        import jax.numpy as jnp
+
+        from gke_ray_train_tpu.models.transformer import init_params
+        key = jax.ShapeDtypeStruct((2,), jnp.uint32)  # legacy raw key
+        return jax.eval_shape(lambda k: init_params(model_cfg, k), key)
+
+    def donate_argnums(self) -> Tuple[int, ...]:
+        if self.donate_state and self.donate_batch:
+            return (0, 1)
+        return (0,) if self.donate_state else ()
+
+    def runtime_guards(self):
+        """The resolved guard bundle ``run_training`` consumes."""
+        from gke_ray_train_tpu.analysis.guards import RuntimeGuards
+        return RuntimeGuards(transfer_mode=self.transfer_guard,
+                             divergence=self.divergence_guard)
+
+    def global_batch(self, n_chips: Optional[int] = None) -> int:
+        sizes = self.resolved_sizes(n_chips)
+        return (self.per_device_batch * sizes["data"] * sizes["fsdp"]
+                * self.grad_accum)
+
+    # ------------------------------------------------------------------
+    # static feasibility (the arithmetic plancheck builds on)
+    # ------------------------------------------------------------------
+
+    def mesh_findings(self, n_chips: Optional[int] = None) -> List[str]:
+        """Topology feasibility: every axis size tiles the chip count."""
+        n = self.chips if n_chips is None else n_chips
+        try:
+            self.resolved_sizes(n)
+        except ValueError as e:
+            return [f"mesh {{{', '.join(f'{a}={getattr(self, a)}' for a in MESH_AXES)}}} "
+                    f"does not tile {n} chips ({self.topology if n_chips is None else n}): {e}"]
+        return []
+
+    def model_findings(self, model_cfg,
+                       n_chips: Optional[int] = None) -> List[str]:
+        """Model-dim divisibility against the resolved mesh: every
+        sharded dim of every param leaf (embed, heads, mlp, vocab, the
+        stacked-layer pipe dim) must divide the product of the axes its
+        logical PartitionSpec names — plus the activation-level
+        head/sequence constraints the leaf shapes alone cannot see."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        out = self.mesh_findings(n_chips)
+        if out:
+            return out
+        sizes = self.resolved_sizes(n_chips)
+
+        def axes_size(entry) -> Tuple[int, Tuple[str, ...]]:
+            names = (entry if isinstance(entry, (tuple, list))
+                     else (entry,)) if entry is not None else ()
+            prod = 1
+            for a in names:
+                prod *= sizes[a]
+            return prod, tuple(names)
+
+        specs = self.logical_param_specs(model_cfg)
+        shapes = self.abstract_params(model_cfg)
+        spec_leaves = jax.tree_util.tree_leaves_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))
+        shape_map = {jax.tree_util.keystr(p): s.shape
+                     for p, s in jax.tree_util.tree_leaves_with_path(shapes)}
+        for path, spec in spec_leaves:
+            name = jax.tree_util.keystr(path)
+            shape = shape_map.get(name)
+            if shape is None:
+                continue
+            for d, entry in enumerate(spec):
+                prod, names = axes_size(entry)
+                if prod > 1 and shape[d] % prod != 0:
+                    out.append(
+                        f"param {name} dim {d} (size {shape[d]}) is not "
+                        f"divisible by mesh axes {names} "
+                        f"(size {prod}) on {n_chips or self.topology}")
+        # activation-level constraints
+        if sizes["model"] > 1:
+            for field in ("n_heads", "n_kv_heads"):
+                heads = getattr(model_cfg, field)
+                if heads % sizes["model"] != 0:
+                    out.append(
+                        f"{field}={heads} is not divisible by the model "
+                        f"axis (size {sizes['model']}) — attention heads "
+                        "cannot tile the tensor-parallel axis")
+        if sizes["context"] > 1 and self.max_seq_len % sizes["context"]:
+            out.append(
+                f"max_seq_len={self.max_seq_len} is not divisible by the "
+                f"context axis (size {sizes['context']})")
+        if sizes["pipe"] > 1:
+            depth = model_cfg.n_repeats
+            if depth % (sizes["pipe"] * self.pipe_virtual_stages):
+                out.append(
+                    f"n_repeats={depth} is not divisible by pipe axis x "
+                    f"virtual stages ({sizes['pipe']} x "
+                    f"{self.pipe_virtual_stages})")
+        return out
+
+    def feasibility(self, model_cfg=None,
+                    n_chips: Optional[int] = None) -> List[str]:
+        """All static findings for one topology (mesh + model dims)."""
+        if model_cfg is None:
+            return self.mesh_findings(n_chips)
+        return self.model_findings(model_cfg, n_chips)
+
+
+# ---------------------------------------------------------------------------
+# field <-> flat-config-key mapping (the dialect bridge; PLAN005 checks
+# it against config.py's KNOWN_KEYS in both directions)
+# ---------------------------------------------------------------------------
+
+CONFIG_KEYS: Dict[str, str] = {
+    "data": "MESH_DATA",
+    "fsdp": "MESH_FSDP",
+    "model": "MESH_MODEL",
+    "context": "MESH_CONTEXT",
+    "pipe": "MESH_PIPE",
+    "num_slices": "NUM_SLICES",
+    "pipe_microbatches": "PIPE_MICROBATCHES",
+    "pipe_virtual_stages": "PIPE_VIRTUAL_STAGES",
+    "per_device_batch": "PER_DEVICE_TRAIN_BATCH_SIZE",
+    "grad_accum": "GRADIENT_ACCUMULATION_STEPS",
+    "max_seq_len": "MAX_SEQ_LENGTH",
+    "packing": "PACKING",
+    "donate_state": "DONATE_STATE",
+    "donate_batch": "DONATE_BATCH",
+    "prefetch": "PREFETCH_BATCHES",
+    "compile_cache": "COMPILE_CACHE",
+    "compile_cache_dir": "COMPILE_CACHE_DIR",
+    "aot_train_step": "AOT_TRAIN_STEP",
+    "transfer_guard": "TRANSFER_GUARD",
+    "recompile_limit": "RECOMPILE_LIMIT",
+    "divergence_guard": "DIVERGENCE_GUARD",
+    "topology": "TOPOLOGY",
+    "budget_preset": "BUDGET_PRESET",
+}
+
+# the fields that determine the COMPILED PROGRAM (mesh layout, batch
+# shape, donation, pipeline schedule). compile_fingerprint() hashes
+# exactly these; plancheck's PLAN004 budget-compatibility rule compares
+# exactly these — one list, no drift between the two.
+COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
+    "data", "fsdp", "model", "context", "pipe", "num_slices",
+    "pipe_microbatches", "pipe_virtual_stages",
+    "per_device_batch", "grad_accum", "max_seq_len", "packing",
+    "donate_state", "donate_batch")
+
+# plan knobs the trainer forwards from the driver env to Ray workers
+# (rayint/trainer.py) — derived from the mapping so a renamed knob
+# cannot silently stop being forwarded
+ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
+    CONFIG_KEYS[f] for f in (
+        "compile_cache", "compile_cache_dir", "aot_train_step",
+        "transfer_guard", "recompile_limit", "divergence_guard",
+        "prefetch")))
+
+_BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
+                          "compile_cache", "aot_train_step",
+                          "divergence_guard"})
+_INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
+                         "num_slices", "pipe_microbatches",
+                         "pipe_virtual_stages", "per_device_batch",
+                         "grad_accum", "max_seq_len", "prefetch",
+                         "recompile_limit"})
+
+
+def _coerce(field: str, value: Any) -> Any:
+    """One coercion path for all three dialects: JSON values, env-var
+    strings, and python kwargs normalize to the same field types, so
+    the fingerprints agree."""
+    if field in _BOOL_FIELDS:
+        return _as_bool(value, field)
+    if field in _INT_FIELDS:
+        return _as_int(value, field)
+    if field == "transfer_guard":
+        v = (str(value).strip().lower() if value is not None else None)
+        if v in ("", "0", "off", "false", "allow", None):
+            return None
+        return v
+    if field in ("compile_cache_dir", "budget_preset"):
+        return str(value) if value is not None else None
+    if field == "topology":
+        return str(value).strip().lower()
+    return value
+
+
+# ---------------------------------------------------------------------------
+# the one compile surface (the SNIPPETS compile_step_with_plan shape)
+# ---------------------------------------------------------------------------
+
+def compile_step_with_plan(plan: ExecutionPlan, mesh, fn: Callable,
+                           *abstract_args: Any,
+                           in_shardings: Any = None,
+                           out_shardings: Any = None,
+                           donate_argnums: Optional[Tuple[int, ...]] = None,
+                           sidecar: Optional[str] = None,
+                           label: str = "train_step") -> Callable:
+    """Compile a step function under one plan — the single surface
+    training, bench, and analysis all route through.
+
+    ``fn`` may be a plain python step body (jitted here with the plan's
+    donation policy and any explicit in/out shardings — PartitionSpec
+    trees are resolved against ``mesh`` into NamedShardings) or an
+    already-jitted function (left as is). When ``abstract_args`` are
+    given, the plan's AOT/compile-cache policy applies: the step is
+    built ahead of time via ``jit(...).lower(...).compile()`` (hitting
+    the persistent cache when warm) and — when ``sidecar`` is set and
+    ``plan.aot_train_step`` — serialized beside the checkpoint under a
+    key that embeds ``plan.compile_fingerprint()``, so a sidecar
+    recorded under a plan that compiles a DIFFERENT program is stale by
+    construction (operational knobs don't invalidate it).
+    """
+    import jax
+
+    if not hasattr(fn, "lower"):        # plain body → jit under the plan
+        kw: Dict[str, Any] = {}
+        if in_shardings is not None or out_shardings is not None:
+            if in_shardings is None or out_shardings is None:
+                raise PlanError(
+                    "compile_step_with_plan needs BOTH in_shardings and "
+                    "out_shardings (or neither — GSPMD propagates from "
+                    "the plan-sharded arguments)")
+            if mesh is not None:
+                # logical PartitionSpec leaves → concrete NamedShardings
+                # (already-concrete sharding leaves pass through)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                def concretize(tree):
+                    return jax.tree.map(
+                        lambda s: NamedSharding(mesh, s)
+                        if isinstance(s, PartitionSpec) else s,
+                        tree,
+                        is_leaf=lambda x: isinstance(
+                            x, (PartitionSpec, NamedSharding)))
+
+                in_shardings = concretize(in_shardings)
+                out_shardings = concretize(out_shardings)
+            kw.update(in_shardings=in_shardings,
+                      out_shardings=out_shardings)
+        argnums = (plan.donate_argnums() if donate_argnums is None
+                   else tuple(donate_argnums))
+        fn = jax.jit(fn, donate_argnums=argnums, **kw)
+        try:
+            fn.donate_argnums = argnums
+        except (AttributeError, TypeError):  # pragma: no cover
+            pass
+    if not abstract_args or not plan.aot_train_step:
+        # AOT disabled by the plan: the plain jitted step (first call
+        # traces+compiles, hitting the persistent cache when warm)
+        return fn
+    from gke_ray_train_tpu.perf.cache import build_or_load_step
+    return build_or_load_step(fn, *abstract_args, sidecar=sidecar,
+                              label=label, plan=plan)

@@ -193,14 +193,29 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         install_recompile_limit, uninstall_recompile_limit)
     from gke_ray_train_tpu.perf.cache import (
         enable_persistent_cache, log_cache_summary)
+    from gke_ray_train_tpu.plan import ExecutionPlan, PlanError
     from gke_ray_train_tpu.rayint.context import get_context
     from gke_ray_train_tpu.train import preempt
+    # the worker's declarative ExecutionPlan (plan.py): resolved from
+    # the same config+env the loop fn will read, logged up front so
+    # every attempt states the plan identity it runs under. Purely
+    # static — no backend is touched before distributed_init.
+    plan = None
+    try:
+        plan = ExecutionPlan.resolve(config)
+        logger.info("execution plan %s (topology %s)",
+                    plan.fingerprint(), plan.topology)
+    except PlanError as e:
+        # a config in a non-flat dialect (the pretrain driver refines
+        # its plan in the entry) must not kill the attempt here
+        logger.warning("worker-level plan resolution failed (%s); the "
+                       "entry's own plan still applies", e)
     # compile-once across restarts: every attempt (and every retry of a
     # preempted worker) reuses the persistent XLA cache instead of
     # paying a full recompile. Config-only here — the backend must not
     # initialize before distributed_init; the entry scripts re-enable
     # after it so the cache dir gains the real topology fingerprint.
-    enable_persistent_cache()
+    enable_persistent_cache(plan=plan)
     ctx = get_context()
     ctx.resumed_step = None      # fresh attempt, fresh metadata
     ctx.set_heartbeat_sink(beat_fn)
@@ -400,16 +415,15 @@ class JaxTrainer:
                 "COORDINATOR_ADDRESS": f"{coord_ip}:{coord_port}",
                 "NUM_PROCESSES": str(n),
             }
-            # compile-cache + runtime-guard knobs ride to the workers
-            # explicitly — a driver-side `env COMPILE_CACHE_DIR=...` or
-            # `env TRANSFER_GUARD=disallow` must shape the workers even
-            # without a Ray runtime-env entry
-            env_base.update({
-                k: os.environ[k]
-                for k in ("COMPILE_CACHE_DIR", "COMPILE_CACHE",
-                          "AOT_TRAIN_STEP", "TRANSFER_GUARD",
-                          "RECOMPILE_LIMIT", "DIVERGENCE_GUARD")
-                if k in os.environ})
+            # plan-scoped knobs ride to the workers explicitly — a
+            # driver-side `env COMPILE_CACHE_DIR=...` or `env
+            # TRANSFER_GUARD=disallow` must shape the workers even
+            # without a Ray runtime-env entry. The key list is DERIVED
+            # from the ExecutionPlan's config-key mapping (plan.py), so
+            # a renamed knob cannot silently stop being forwarded.
+            from gke_ray_train_tpu.plan import ENV_FORWARD_KEYS
+            env_base.update({k: os.environ[k] for k in ENV_FORWARD_KEYS
+                             if k in os.environ})
             futures = [
                 w.run.remote(self.fn, self.config,
                              {**env_base, "PROCESS_ID": str(i)}, supervisor)

@@ -137,18 +137,30 @@ def make_train_state(cfg: ModelConfig, optimizer: optax.GradientTransformation,
                       step=step)
 
 
+# sentinel: distinguishes "caller did not pass it" (plan supplies the
+# value) from an explicit override
+_UNSET: Any = object()
+
+
 def make_train_step(cfg: ModelConfig,
                     optimizer: optax.GradientTransformation,
                     *,
                     mesh: Optional[Mesh] = None,
                     lora_cfg: Optional[LoraConfig] = None,
-                    grad_accum: int = 1,
+                    grad_accum: Any = _UNSET,
                     schedule: Optional[Callable] = None,
-                    donate: bool = True,
-                    donate_batch: bool = True,
-                    pipe_microbatches: Optional[int] = None
+                    donate: Any = _UNSET,
+                    donate_batch: Any = _UNSET,
+                    pipe_microbatches: Any = _UNSET,
+                    plan=None
                     ) -> Callable[[TrainState, Batch], tuple]:
     """Build the jitted ``(state, batch) -> (state, metrics)`` function.
+
+    ``plan``: an :class:`~gke_ray_train_tpu.plan.ExecutionPlan` — the
+    declarative source for grad_accum / donation / pipeline
+    microbatching (explicit kwargs still win), and the route through
+    ``plan.compile_step_with_plan`` so training, bench and analysis
+    share ONE compile surface.
 
     batch: dict with "inputs"/"targets" [B, S] int32, "weights" [B, S]
     float, optional "segment_ids"/"positions" [B, S]. B must be divisible
@@ -165,6 +177,15 @@ def make_train_step(cfg: ModelConfig,
     ``pipe_microbatches``: pipeline microbatch count per forward when the
     mesh has a pipe axis > 1 (models/pipeline.py; default = stage count).
     """
+    if grad_accum is _UNSET:
+        grad_accum = plan.grad_accum if plan is not None else 1
+    if donate is _UNSET:
+        donate = plan.donate_state if plan is not None else True
+    if donate_batch is _UNSET:
+        donate_batch = plan.donate_batch if plan is not None else True
+    if pipe_microbatches is _UNSET:
+        pipe_microbatches = (plan.pipe_microbatches or None) \
+            if plan is not None else None
     lora_mode = lora_cfg is not None
     lora_dropout = lora_cfg.dropout if lora_mode else 0.0
     moe = cfg.n_experts > 0
@@ -256,6 +277,12 @@ def make_train_step(cfg: ModelConfig,
 
     argnums = (0, 1) if (donate and donate_batch) else \
         ((0,) if donate else ())
+    if plan is not None:
+        # one compile surface: plan-routed steps jit through
+        # compile_step_with_plan (which also tags donate_argnums)
+        from gke_ray_train_tpu.plan import compile_step_with_plan
+        return compile_step_with_plan(plan, mesh, train_step,
+                                      donate_argnums=argnums)
     fn = jax.jit(train_step, donate_argnums=argnums)
     try:
         # introspection hook for tests/tooling: jit wrappers do not

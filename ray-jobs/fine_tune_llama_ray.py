@@ -45,8 +45,7 @@ def train_loop_per_worker(config: dict):
         tokenize_sft_example, format_gretel_sql_example)
     from gke_ray_train_tpu.models import (
         init_params, param_specs, preset_for_model_id, tiny)
-    from gke_ray_train_tpu.parallel.mesh import (
-        MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.parallel.mesh import distributed_init
     from gke_ray_train_tpu.parallel.placement import (
         host_batch_size, input_shard_layout, make_place_batch)
     from gke_ray_train_tpu.parallel.sharding import tree_shardings
@@ -67,19 +66,27 @@ def train_loop_per_worker(config: dict):
     if ctx.is_host0():
         audit_config(config)   # §5.6: every key honored or warned, never
                                # silently dropped
+    # ONE declarative ExecutionPlan (plan.py) resolves every execution
+    # knob — mesh, batch shape, donation, prefetch, compile-once
+    # policy, runtime guards — from the config (env fallback), and its
+    # fingerprint identifies the run in cache dirs, AOT sidecar keys
+    # and BENCH/budget records
+    from gke_ray_train_tpu.plan import ExecutionPlan, compile_step_with_plan
+    plan = ExecutionPlan.resolve(config)
     apply_debug_flags(config)
     distributed_init()
     # persistent XLA compile cache (perf/cache.py): restarts and peer
     # hosts reuse the compiled binary; re-enabled post-init so the
     # cache dir carries the real device-topology fingerprint
     from gke_ray_train_tpu.perf.cache import enable_persistent_cache
-    enable_persistent_cache(config.get("COMPILE_CACHE_DIR"))
-    mesh = build_mesh(MeshConfig.from_dict(config))
+    enable_persistent_cache(plan=plan)
+    mesh = plan.build_mesh()
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
     smoke = bool(config.get("SMOKE_TEST", False))
-    logger.info("worker %d/%d; %d devices; mesh %s", host, n_hosts,
-                len(jax.devices()), dict(mesh.shape))
+    logger.info("worker %d/%d; %d devices; mesh %s; plan %s", host,
+                n_hosts, len(jax.devices()), dict(mesh.shape),
+                plan.fingerprint())
 
     # ---- tokenizer + model config ------------------------------------
     model_id = config["MODEL_ID"]
@@ -91,7 +98,7 @@ def train_loop_per_worker(config: dict):
                        type(e).__name__)
         tokenizer = ByteTokenizer()
 
-    max_seq = int(config.get("MAX_SEQ_LENGTH", 1024))
+    max_seq = plan.max_seq_len
     use_lora = bool(config.get("USE_QLORA", False))
     # frozen-base (Q)LoRA keeps unquantized leaves (embed/lm_head/norms)
     # in the compute dtype — fp32 embeddings alone add ~4 GB at 8B dims
@@ -213,8 +220,8 @@ def train_loop_per_worker(config: dict):
         raise ValueError("every train example truncated to zero trainable "
                          "tokens; training would silently learn nothing")
 
-    per_device_batch = int(config.get("PER_DEVICE_TRAIN_BATCH_SIZE", 2))
-    grad_accum = int(config.get("GRADIENT_ACCUMULATION_STEPS", 1))
+    per_device_batch = plan.per_device_batch
+    grad_accum = plan.grad_accum
     data_par = mesh.shape["data"] * mesh.shape["fsdp"]
     global_batch = per_device_batch * data_par * grad_accum
     # input partitioning follows the mesh, not process_count: hosts
@@ -222,7 +229,7 @@ def train_loop_per_worker(config: dict):
     in_shards, in_shard_id = input_shard_layout(mesh)
     host_batch = host_batch_size(global_batch, num_shards=in_shards)
 
-    packing = bool(config.get("PACKING", False))
+    packing = plan.packing
     if packing:
         packed = list(pack_examples(train_exs, max_seq))
         train_rows = {k: np.stack([r[k] for r in packed])
@@ -258,22 +265,26 @@ def train_loop_per_worker(config: dict):
                              lora_cfg=lora_cfg, params=params)
 
     # pipeline-parallel meshes (MESH_PIPE>1) microbatch each forward;
-    # 0/unset = default (one microbatch per stage)
-    pipe_micro = int(config.get("PIPE_MICROBATCHES", 0)) or None
+    # 0/unset = default (one microbatch per stage) — all plan-resolved
+    pipe_micro = plan.pipe_microbatches or None
     if "PIPE_VIRTUAL_STAGES" in config:
         import dataclasses as _dc
         # invalid values (0, negatives) must fail ModelConfig validation,
         # not silently fall back to the shift schedule
-        cfg = _dc.replace(cfg,
-                          pipe_virtual=int(config["PIPE_VIRTUAL_STAGES"]))
+        cfg = _dc.replace(cfg, pipe_virtual=plan.pipe_virtual_stages)
+    # grad_accum / donation / pipe microbatching come from the plan —
+    # make_train_step routes through the one compile surface
+    # (plan.compile_step_with_plan)
     step_fn = make_train_step(cfg, opt, mesh=mesh, lora_cfg=lora_cfg,
-                              grad_accum=grad_accum, schedule=schedule,
-                              pipe_microbatches=pipe_micro)
+                              schedule=schedule, plan=plan)
     # explicit batch shardings pin eval to ONE compiled layout (no
     # retrace per distinct batch placement, no silent replication on
     # multi-host meshes) — the same contract the train step gets from
     # make_place_batch
     from gke_ray_train_tpu.train.step import batch_shardings
+    # ground truth from the BUILT mesh (a declared -1 context axis may
+    # have filled to >1; plan.context_sharded resolves, but the mesh is
+    # authoritative at this point)
     ctx_sharded = mesh.shape["context"] > 1
     eval_fn_step = make_eval_step(
         cfg, mesh=mesh, lora_cfg=lora_cfg, pipe_microbatches=pipe_micro,
@@ -282,19 +293,18 @@ def train_loop_per_worker(config: dict):
             context_sharded=ctx_sharded))
     out_base = config.get("OUTPUT_DIR_BASE", "/tmp/grt_sft")
     sft_dir = os.path.join(out_base, config.get("SFT_SUBDIR_NAME", "sft"))
-    # AOT train executable beside the checkpoint (perf/cache.py): a
-    # preempted retry deserializes it and reaches its first step with
-    # zero retracing; signature drift falls back to the jitted step
-    from gke_ray_train_tpu.perf.cache import (
-        aot_enabled, build_or_load_step, make_abstract_batch)
-    if aot_enabled(config):
-        step_fn = build_or_load_step(
-            step_fn, state,
-            make_abstract_batch(mesh, global_batch, max_seq,
-                                packed=packing,
-                                context_sharded=ctx_sharded),
-            sidecar=os.path.join(sft_dir, "aot_train_step.bin"),
-            label="sft train_step")
+    # AOT train executable beside the checkpoint (perf/cache.py), under
+    # the plan's policy: a preempted retry deserializes it and reaches
+    # its first step with zero retracing; signature OR plan-fingerprint
+    # drift falls back to the jitted step
+    from gke_ray_train_tpu.perf.cache import make_abstract_batch
+    step_fn = compile_step_with_plan(
+        plan, mesh, step_fn, state,
+        make_abstract_batch(mesh, global_batch, max_seq,
+                            packed=packing,
+                            context_sharded=ctx_sharded),
+        sidecar=os.path.join(sft_dir, "aot_train_step.bin"),
+        label="sft train_step")
     # SAVE_STRATEGY / EVALUATION_STRATEGY_SFT honored (config.py;
     # reference fine_tune_config.json:22-25)
     cadence = cadence_from_config(config)
@@ -353,18 +363,17 @@ def train_loop_per_worker(config: dict):
     # global sharded arrays; identical path single-host
     place = make_place_batch(mesh, context_sharded=ctx_sharded)
 
-    # shardlint runtime guards (analysis/guards.py): TRANSFER_GUARD /
-    # DIVERGENCE_GUARD resolved config-key-first, env fallback
-    from gke_ray_train_tpu.analysis.guards import RuntimeGuards
+    # shardlint runtime guards (analysis/guards.py), resolved from the
+    # plan (config-key-first, env fallback — same precedence as before)
     state, metrics = run_training(
         state, step_fn, epoch_batches,
         epochs=epochs,
         place_batch=place,
-        guards=RuntimeGuards.from_config(config),
+        guards=plan.runtime_guards(),
         # asynchronous input pipeline (data/prefetch.py): tokenize/pack +
         # sharded host→device transfer overlap the train step; depth 2
         # device-resident batches by default, 0 = synchronous
-        prefetch=int(config.get("PREFETCH_BATCHES", 2)),
+        prefetch=plan.prefetch,
         log_every=int(config.get("LOGGING_STEPS", 10)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),

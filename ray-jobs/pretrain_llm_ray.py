@@ -38,8 +38,7 @@ def train_loop_per_worker(config: dict):
         CharTokenizer, ShardedBatches, SlidingWindowDataset,
         prepare_wikitext2)
     from gke_ray_train_tpu.models import basic_lm
-    from gke_ray_train_tpu.parallel.mesh import (
-        MeshConfig, build_mesh, distributed_init)
+    from gke_ray_train_tpu.parallel.mesh import distributed_init
     from gke_ray_train_tpu.parallel.placement import (
         input_shard_layout, make_place_batch)
     from gke_ray_train_tpu.rayint import get_context
@@ -50,15 +49,31 @@ def train_loop_per_worker(config: dict):
 
     ctx = get_context()
     distributed_init()
+    seq_len = int(config.get("dataset_seq_len", 256))
+    # ONE declarative ExecutionPlan (plan.py): env supplies the
+    # guard/compile-cache knobs, the driver config supplies mesh +
+    # batch shape via the kwargs dialect — identical plan (and
+    # fingerprint) to the same settings spelled in the JSON dialect
+    from gke_ray_train_tpu.plan import ExecutionPlan, compile_step_with_plan
+    plan = ExecutionPlan.resolve(
+        config={k: config[k] for k in
+                ("MESH_DATA", "MESH_FSDP", "COMPILE_CACHE_DIR")
+                if k in config},
+        per_device_batch=int(config.get("batch_size_per_device", 16)),
+        max_seq_len=seq_len,
+        prefetch=int(config.get("prefetch_batches",
+                                config.get("PREFETCH_BATCHES", 2))))
     # persistent XLA compile cache on the shared PVC: the first worker
     # to compile pays; every restart (and every other host) reuses the
     # binary. Re-enabled here (the trainer already enabled it pre-init)
     # so the cache dir carries the real device-topology fingerprint.
     from gke_ray_train_tpu.perf.cache import enable_persistent_cache
-    enable_persistent_cache(config.get("COMPILE_CACHE_DIR"))
-    mesh = build_mesh(MeshConfig.from_dict(config))
+    enable_persistent_cache(plan=plan)
+    mesh = plan.build_mesh()
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
+    logger.info("worker %d/%d; mesh %s; plan %s", host, n_hosts,
+                dict(mesh.shape), plan.fingerprint())
 
     data_dir = config.get("data_dir", "/mnt/pvc/data")
     tok_path = os.path.join(data_dir, "char_tokenizer.json")
@@ -80,7 +95,6 @@ def train_loop_per_worker(config: dict):
 
     tok = CharTokenizer.load(tok_path)
     ids = np.load(ids_path)
-    seq_len = int(config.get("dataset_seq_len", 256))
     dataset = SlidingWindowDataset(ids, seq_len)
 
     cfg = basic_lm(
@@ -94,7 +108,7 @@ def train_loop_per_worker(config: dict):
         remat_policy=config.get("remat_policy", "full"),
     )
 
-    global_batch = int(config.get("batch_size_per_device", 16)) \
+    global_batch = plan.per_device_batch \
         * mesh.shape["data"] * mesh.shape["fsdp"]
     # test_run parity: cap at 16k samples (pytorch_llm_ray.py:198-201);
     # "max_samples" shrinks further for fast CI smoke
@@ -118,22 +132,22 @@ def train_loop_per_worker(config: dict):
                          clip_norm=float(config.get("grad_clip", 1.0)))
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
 
-    step_fn = make_train_step(cfg, opt, mesh=mesh, schedule=schedule)
+    step_fn = make_train_step(cfg, opt, mesh=mesh, schedule=schedule,
+                              plan=plan)
     run_dir = os.path.join(
         config.get("storage_path", "/mnt/pvc/ray_llm_training_runs"),
         config.get("run_name", "basic_lm"))
-    # AOT train executable beside the checkpoint (perf/cache.py): build
-    # once via jit(...).lower(...).compile() and serialize; a preempted
-    # retry deserializes it and reaches its first step without
-    # retracing. Any signature drift falls back to the jitted step.
-    from gke_ray_train_tpu.perf.cache import (
-        aot_enabled, build_or_load_step, make_abstract_batch)
-    if aot_enabled(config):
-        step_fn = build_or_load_step(
-            step_fn, state, make_abstract_batch(mesh, global_batch,
-                                                seq_len),
-            sidecar=os.path.join(run_dir, "aot_train_step.bin"),
-            label="pretrain train_step")
+    # AOT train executable beside the checkpoint (perf/cache.py),
+    # under the plan's AOT policy: build once via
+    # jit(...).lower(...).compile() and serialize; a preempted retry
+    # deserializes it and reaches its first step without retracing.
+    # Signature or plan-fingerprint drift falls back to the jitted step.
+    from gke_ray_train_tpu.perf.cache import make_abstract_batch
+    step_fn = compile_step_with_plan(
+        plan, mesh, step_fn, state,
+        make_abstract_batch(mesh, global_batch, seq_len),
+        sidecar=os.path.join(run_dir, "aot_train_step.bin"),
+        label="pretrain train_step")
     # recency retention, keep 2 (NOT the reference's keep-1-best): the
     # training manager exists to RESUME — best-by-loss retention would
     # garbage-collect a grace-window preemption save whose loss is not
@@ -149,21 +163,19 @@ def train_loop_per_worker(config: dict):
 
     meter = ThroughputMeter(cfg, seq_len=seq_len,
                             n_devices=len(jax.devices()))
-    from gke_ray_train_tpu.analysis.guards import RuntimeGuards
     from gke_ray_train_tpu.train.profiling import profiler_from_config
     state, metrics = run_training(
         state, step_fn, lambda e: batches.iter_epoch(e),
         epochs=epochs,
         # shardlint runtime guards: TRANSFER_GUARD / DIVERGENCE_GUARD
-        # (analysis/guards.py), config-key-first with env fallback
-        guards=RuntimeGuards.from_config(config),
+        # (analysis/guards.py), plan-resolved (env dialect)
+        guards=plan.runtime_guards(),
         # host-local rows → global sharded arrays (SURVEY.md row D9)
         place_batch=make_place_batch(
             mesh, context_sharded=mesh.shape["context"] > 1),
         # background prefetch overlaps the sliding-window slice + form-up
         # with the step (data/prefetch.py); 0 = synchronous
-        prefetch=int(config.get("prefetch_batches",
-                                config.get("PREFETCH_BATCHES", 2))),
+        prefetch=plan.prefetch,
         log_every=int(config.get("log_every", 20)),
         meter=meter, ckpt_manager=mgr,
         report_fn=lambda m: ctx.report(m),

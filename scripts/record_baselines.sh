@@ -56,6 +56,12 @@ run budget-check   python -m gke_ray_train_tpu.perf.budget check
 run shardlint      python -m gke_ray_train_tpu.analysis lint
 run shardlint-check python -m gke_ray_train_tpu.analysis check
 
+# plancheck (analysis/plancheck.py): static ExecutionPlan verification
+# over the shipped configs — topology feasibility, model-dim
+# divisibility, the checkpoint-portability matrix, budget fingerprint
+# + KNOWN_KEYS consistency. No backend needed (safe on a dead chip).
+run plancheck      python -m gke_ray_train_tpu.analysis plancheck
+
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
 # defaults on the seq4k shape where the kernel dominates (up to 8 extra
 # bench runs; the default q=256/kv=1024 cell IS the `seq4k` record

@@ -1,0 +1,441 @@
+"""ExecutionPlan (plan.py) + plancheck (analysis/plancheck.py).
+
+The contract under test (ISSUE 6):
+- the three legacy dialects (flat JSON config, env vars, pythonic
+  kwargs) produce IDENTICAL plans and fingerprints;
+- the static feasibility matrix accepts the shipped presets
+  (tiny_fsdp8 / tiny_dp8, every ray-jobs config) and rejects each
+  seeded violation class with the rule + offending field named:
+  infeasible axis size, non-divisible model dim, save/restore pair
+  with no valid reshard, stale budget preset, KNOWN_KEYS drift;
+- one fingerprint identifies a preset across the budget JSON, the
+  budget comparator's failure message and the AOT sidecar key;
+- the reshard-on-restore path restores a checkpoint saved on the
+  8-device mesh onto a 4-device mesh from the logical spec.
+"""
+
+import dataclasses
+import json
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.analysis.plancheck import (
+    budget_findings, check_config, drift_findings, feasibility_findings,
+    model_config_for, portability_findings, repo_budget_findings)
+from gke_ray_train_tpu.models import tiny
+from gke_ray_train_tpu.perf.budget import (
+    PRESETS, BudgetViolation, assert_within_budget, budget_path,
+    load_budget, plan_for_preset, write_budget)
+from gke_ray_train_tpu.plan import (
+    CONFIG_KEYS, ENV_FORWARD_KEYS, ExecutionPlan, PlanError,
+    compile_step_with_plan)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# dialect round-trips
+# ---------------------------------------------------------------------------
+
+def test_three_dialects_identical_plan_and_fingerprint():
+    settings = dict(data=2, fsdp=4, per_device_batch=1, grad_accum=2,
+                    max_seq_len=128, prefetch=3, transfer_guard="disallow",
+                    recompile_limit=2, divergence_guard=True,
+                    donate_batch=False, topology="cpu-8",
+                    budget_preset="tiny_fsdp8")
+    from_kwargs = ExecutionPlan.from_kwargs(**settings)
+    flat = from_kwargs.to_config()
+    from_json = ExecutionPlan.from_config(json.loads(json.dumps(flat)))
+    # env dialect: every value is a string
+    from_env = ExecutionPlan.from_env(
+        {k: str(v) for k, v in flat.items() if v is not None})
+    assert from_kwargs == from_json == from_env
+    assert from_kwargs.fingerprint() == from_json.fingerprint() \
+        == from_env.fingerprint()
+
+
+def test_fingerprint_changes_with_any_field():
+    base = ExecutionPlan()
+    assert dataclasses.replace(base, prefetch=5).fingerprint() \
+        != base.fingerprint()
+    assert dataclasses.replace(base, model=2).fingerprint() \
+        != base.fingerprint()
+
+
+def test_compile_fingerprint_ignores_operational_knobs():
+    base = ExecutionPlan()
+    # toggling prefetch/guards/cache-dir must NOT invalidate compiled
+    # artifacts (same program) ...
+    for f, v in (("prefetch", 0), ("transfer_guard", "log"),
+                 ("recompile_limit", 3), ("compile_cache_dir", "/x")):
+        assert dataclasses.replace(base, **{f: v}).compile_fingerprint() \
+            == base.compile_fingerprint(), f
+    # ... while program-shaping fields must
+    for f, v in (("grad_accum", 2), ("model", 2), ("packing", True),
+                 ("donate_state", False)):
+        assert dataclasses.replace(base, **{f: v}).compile_fingerprint() \
+            != base.compile_fingerprint(), f
+
+
+def test_context_sharded_resolves_fill_axis():
+    plan = ExecutionPlan.from_kwargs(context=-1, fsdp=2, topology="cpu-8")
+    assert plan.resolved_sizes()["context"] == 4
+    assert plan.context_sharded
+    assert not ExecutionPlan.from_kwargs(fsdp=-1).context_sharded
+
+
+def test_resolve_config_wins_over_env():
+    plan = ExecutionPlan.resolve(
+        config={"PREFETCH_BATCHES": 7},
+        env={"PREFETCH_BATCHES": "3", "TRANSFER_GUARD": "log"})
+    assert plan.prefetch == 7            # config beats env
+    assert plan.transfer_guard == "log"  # env fills the gap
+    # kwarg overrides beat both
+    assert ExecutionPlan.resolve(
+        config={"PREFETCH_BATCHES": 7}, env={}, prefetch=1).prefetch == 1
+
+
+def test_validation_rejects_bad_fields():
+    with pytest.raises(PlanError):
+        ExecutionPlan.from_kwargs(data=0)
+    with pytest.raises(PlanError):
+        ExecutionPlan.from_kwargs(transfer_guard="bogus")
+    with pytest.raises(PlanError):
+        ExecutionPlan.from_kwargs(topology="v9z-512")
+    with pytest.raises(PlanError):
+        ExecutionPlan.from_kwargs(not_a_field=1)
+    with pytest.raises(PlanError):
+        ExecutionPlan.from_config({"MESH_DATA": "three"})
+
+
+def test_env_forward_keys_derived_from_mapping():
+    assert set(ENV_FORWARD_KEYS) <= set(CONFIG_KEYS.values())
+    for key in ("TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
+                "COMPILE_CACHE_DIR", "COMPILE_CACHE", "AOT_TRAIN_STEP",
+                "PREFETCH_BATCHES"):
+        assert key in ENV_FORWARD_KEYS
+
+
+def test_tpu002_vocabulary_reads_from_plan():
+    from gke_ray_train_tpu.analysis.astlint import default_mesh_vocabulary
+    assert default_mesh_vocabulary() == set(ExecutionPlan.axis_names()) \
+        == {"data", "fsdp", "model", "context", "pipe"}
+
+
+# ---------------------------------------------------------------------------
+# feasibility matrix
+# ---------------------------------------------------------------------------
+
+def test_presets_feasible_on_canonical_mesh():
+    for name in PRESETS:
+        plan = plan_for_preset(name)
+        cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+                   d_ff=128, vocab_size=256, max_seq_len=plan.max_seq_len)
+        assert plan.feasibility(cfg) == [], name
+        assert portability_findings(plan, cfg) == [], name
+
+
+def test_shipped_configs_clean():
+    import glob
+    paths = glob.glob(os.path.join(REPO, "ray-jobs",
+                                   "fine_tune_config*.json"))
+    assert paths
+    for p in paths:
+        with open(p) as f:
+            findings = check_config(json.load(f), label=p)
+        assert findings == [], p
+
+
+def test_rejects_infeasible_axis_size():
+    plan = ExecutionPlan.from_kwargs(data=3, topology="cpu-8")
+    msgs = plan.mesh_findings()
+    assert msgs and "3" in msgs[0]
+    findings = feasibility_findings(plan, None, label="seed")
+    assert findings[0].rule == "PLAN001"
+
+
+def test_rejects_non_divisible_model_dim():
+    # smoke vocab 260 over an 8-way model axis: 260 % 8 != 0
+    config = {"SMOKE_TEST": True, "MESH_MODEL": 8, "MESH_FSDP": 1,
+              "TOPOLOGY": "cpu-8"}
+    plan = ExecutionPlan.from_config(config)
+    cfg = model_config_for(config, plan)
+    findings = feasibility_findings(plan, cfg, label="seed")
+    assert any(f.rule == "PLAN002" and "embed" in f.message
+               for f in findings)
+    # the activation-level head constraint is named too
+    assert any("n_heads" in f.message for f in findings)
+
+
+def test_rejects_unportable_save_restore_pair():
+    # model axis pinned to the FULL declared chip count: the elastic
+    # degrade-to-half path (fake-8 -> fake-4) has no valid reshard
+    plan = ExecutionPlan.from_kwargs(model=8, topology="v5e-8")
+    from gke_ray_train_tpu.models.config import llama3_8b
+    findings = portability_findings(plan, llama3_8b())
+    pairs = {f.field for f in findings}
+    assert findings and all(f.rule == "PLAN003" for f in findings)
+    assert "fake-8->fake-4" in pairs and "fake-16->fake-4" in pairs
+    # and the feasible pairs are NOT flagged
+    assert "fake-8->fake-16" not in pairs
+
+
+def test_portability_domain_scales_with_declared_topology():
+    # a legitimately large TP plan is judged against half/declared/
+    # double of ITS topology, not a 4-chip toy it will never restore on
+    plan = ExecutionPlan.from_kwargs(model=8, topology="v5p-64")
+    from gke_ray_train_tpu.analysis.plancheck import portability_chip_counts
+    from gke_ray_train_tpu.models.config import llama3_8b
+    assert portability_chip_counts(plan) == {
+        "fake-32": 32, "fake-64": 64, "fake-128": 128}
+    assert portability_findings(plan, llama3_8b()) == []
+
+
+def test_context_axis_must_divide_sequence():
+    plan = ExecutionPlan.from_kwargs(context=4, fsdp=2, max_seq_len=130,
+                                     topology="cpu-8")
+    msgs = plan.model_findings(tiny(max_seq_len=130))
+    assert any("context" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# budget / fingerprint consistency (PLAN004)
+# ---------------------------------------------------------------------------
+
+def test_budget_json_records_preset_plan_fingerprint():
+    for name in PRESETS:
+        doc = load_budget(budget_path(name))
+        assert doc["_plan_fingerprint"] == plan_for_preset(name).fingerprint()
+    assert repo_budget_findings() == []
+
+
+def test_stale_budget_preset_is_flagged(tmp_path):
+    bdir = tmp_path / "budgets"
+    shutil.copytree(os.path.join(REPO, "tests", "budgets"), bdir)
+    doc = json.loads((bdir / "tiny_fsdp8.json").read_text())
+    doc["_plan_fingerprint"] = "0" * 16      # recorded under an old plan
+    (bdir / "tiny_fsdp8.json").write_text(json.dumps(doc))
+    findings = repo_budget_findings(str(bdir))
+    assert any(f.rule == "PLAN004" and "stale" in f.message
+               for f in findings)
+    plan = plan_for_preset("tiny_fsdp8")
+    per_cfg = budget_findings(plan, budget_dir=str(bdir), label="seed")
+    assert per_cfg and per_cfg[0].rule == "PLAN004"
+
+
+def test_plan_pinning_preset_with_fill_axis_is_clean():
+    # MESH_FSDP=-1 resolves to the preset's fsdp=4 on cpu-8: same
+    # compiled program, so the pin must NOT be flagged
+    plan = ExecutionPlan.from_config({
+        "MESH_DATA": 2, "MESH_FSDP": -1, "TOPOLOGY": "cpu-8",
+        "BUDGET_PRESET": "tiny_fsdp8", "PER_DEVICE_TRAIN_BATCH_SIZE": 1,
+        "MAX_SEQ_LENGTH": 64, "DONATE_STATE": 0, "DONATE_BATCH": 0})
+    assert budget_findings(plan, label="seed") == []
+
+
+def test_plan_pinning_mismatched_preset_is_flagged():
+    # a plan that pins tiny_fsdp8 but compiles a different batch shape
+    plan = dataclasses.replace(plan_for_preset("tiny_fsdp8"),
+                               per_device_batch=4)
+    findings = budget_findings(plan, label="seed")
+    assert findings and findings[0].rule == "PLAN004"
+    assert "per_device_batch" in findings[0].message
+
+
+def test_budget_violation_names_preset_and_fingerprint(tmp_path):
+    plan = plan_for_preset("tiny_fsdp8")
+    doc = load_budget(budget_path("tiny_fsdp8"))
+    report = {k: v for k, v in doc.items() if not k.startswith("_")}
+    report["flops"] = report["flops"] * 10       # a perf regression
+    path = str(tmp_path / "tiny_fsdp8.json")
+    write_budget(doc, path, preset="tiny_fsdp8", plan=plan)
+    with pytest.raises(BudgetViolation) as ei:
+        assert_within_budget(report, path, plan=plan)
+    msg = str(ei.value)
+    assert "tiny_fsdp8" in msg
+    assert plan.fingerprint() in msg
+
+
+# ---------------------------------------------------------------------------
+# KNOWN_KEYS drift (PLAN005)
+# ---------------------------------------------------------------------------
+
+def test_known_keys_drift_clean_on_repo():
+    assert drift_findings() == []
+
+
+def test_known_keys_drift_detected(monkeypatch):
+    import gke_ray_train_tpu.config as config_mod
+    monkeypatch.setattr(
+        config_mod, "PLAN_SCOPED_KEYS",
+        config_mod.PLAN_SCOPED_KEYS | {"RENAMED_KNOB"})
+    findings = drift_findings()
+    assert any(f.rule == "PLAN005" and f.field == "RENAMED_KNOB"
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# the CLI contract: exit 0 clean, exit 1 naming rule + field
+# ---------------------------------------------------------------------------
+
+def _run_cli(*argv):
+    from gke_ray_train_tpu.analysis.__main__ import main
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(list(argv))
+    return rc, buf.getvalue()
+
+
+def test_plancheck_cli_clean_on_shipped_configs():
+    rc, out = _run_cli("plancheck")
+    assert rc == 0
+    assert "plancheck: clean" in out
+
+
+def test_plancheck_cli_rejects_each_seeded_class(tmp_path, monkeypatch):
+    seeds = {
+        "bad_axis.json": ({"SMOKE_TEST": True, "MESH_DATA": 3,
+                           "TOPOLOGY": "cpu-8"}, "PLAN001"),
+        "bad_dim.json": ({"SMOKE_TEST": True, "MESH_MODEL": 8,
+                          "MESH_FSDP": 1, "TOPOLOGY": "cpu-8"}, "PLAN002"),
+        "bad_port.json": ({"MODEL_ID": "meta-llama/Meta-Llama-3.1-8B",
+                           "MESH_MODEL": 8, "TOPOLOGY": "v5e-8"},
+                          "PLAN003"),
+    }
+    for fname, (cfg, rule) in seeds.items():
+        p = tmp_path / fname
+        p.write_text(json.dumps(cfg))
+        rc, out = _run_cli("plancheck", str(p))
+        assert rc == 1, fname
+        assert rule in out, (fname, out)
+    # stale budget: doctored fingerprint in a sandboxed budget dir
+    bdir = tmp_path / "budgets"
+    shutil.copytree(os.path.join(REPO, "tests", "budgets"), bdir)
+    doc = json.loads((bdir / "tiny_dp8.json").read_text())
+    doc["_plan_fingerprint"] = "f" * 16
+    (bdir / "tiny_dp8.json").write_text(json.dumps(doc))
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"SMOKE_TEST": True, "TOPOLOGY": "cpu-8"}))
+    rc, out = _run_cli("plancheck", str(ok), "--budget-dir", str(bdir))
+    assert rc == 1 and "PLAN004" in out and "tiny_dp8" in out
+    # KNOWN_KEYS drift
+    import gke_ray_train_tpu.config as config_mod
+    monkeypatch.setattr(config_mod, "PLAN_SCOPED_KEYS",
+                        config_mod.PLAN_SCOPED_KEYS | {"RENAMED_KNOB"})
+    rc, out = _run_cli("plancheck", str(ok))
+    assert rc == 1 and "PLAN005" in out and "RENAMED_KNOB" in out
+
+
+# ---------------------------------------------------------------------------
+# plan-routed compile surface
+# ---------------------------------------------------------------------------
+
+def _tiny_step_ingredients(mesh, plan):
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=128,
+               vocab_size=256, max_seq_len=plan.max_seq_len)
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
+    B = plan.per_device_batch * mesh.shape["data"] * mesh.shape["fsdp"] \
+        * plan.grad_accum
+    batch = jax.device_put(
+        {"inputs": jnp.zeros((B, plan.max_seq_len), jnp.int32),
+         "targets": jnp.zeros((B, plan.max_seq_len), jnp.int32),
+         "weights": jnp.ones((B, plan.max_seq_len), jnp.float32)},
+        plan.batch_shardings(mesh))
+    return cfg, opt, state, step, batch
+
+
+def test_make_train_step_takes_donation_from_plan(fsdp_mesh):
+    plan = plan_for_preset("tiny_fsdp8")      # donate_state=False
+    _, _, _, step, _ = _tiny_step_ingredients(fsdp_mesh, plan)
+    assert step.donate_argnums == ()
+    donating = dataclasses.replace(plan, donate_state=True,
+                                   donate_batch=True)
+    _, _, _, step2, _ = _tiny_step_ingredients(fsdp_mesh, donating)
+    assert step2.donate_argnums == (0, 1)
+
+
+def test_aot_sidecar_key_embeds_plan_fingerprint(tmp_path, fsdp_mesh):
+    plan = dataclasses.replace(plan_for_preset("tiny_fsdp8"),
+                               aot_train_step=True, max_seq_len=64)
+    cfg, opt, state, step, batch = _tiny_step_ingredients(fsdp_mesh, plan)
+    sidecar = str(tmp_path / "aot.bin")
+    g1 = compile_step_with_plan(plan, fsdp_mesh, step, state, batch,
+                                sidecar=sidecar, label="t")
+    assert g1.info["source"] == "compiled"
+    assert g1.info["plan_fingerprint"] == plan.fingerprint()
+    assert os.path.exists(sidecar)
+    # same plan → deserialized
+    g2 = compile_step_with_plan(plan, fsdp_mesh, step, state, batch,
+                                sidecar=sidecar, label="t")
+    assert g2.info["source"] == "deserialized"
+    # an operational knob change (same compiled program) does NOT
+    # invalidate the sidecar ...
+    tweaked = dataclasses.replace(plan, prefetch=plan.prefetch + 1)
+    g2b = compile_step_with_plan(tweaked, fsdp_mesh, step, state, batch,
+                                 sidecar=sidecar, label="t")
+    assert g2b.info["source"] == "deserialized"
+    # ... a plan that compiles a DIFFERENT program does
+    other = dataclasses.replace(plan, pipe_virtual_stages=2)
+    g3 = compile_step_with_plan(other, fsdp_mesh, step, state, batch,
+                                sidecar=sidecar, label="t")
+    assert g3.info["source"] == "compiled"
+    # identical losses through every path
+    _, m1 = g1(state, batch)
+    _, m2 = g2(state, batch)
+    assert jnp.array_equal(m1["loss"], m2["loss"])
+
+
+def test_aot_disabled_by_plan_returns_jitted_step(fsdp_mesh, tmp_path):
+    plan = plan_for_preset("tiny_fsdp8")      # aot_train_step=False
+    _, _, state, step, batch = _tiny_step_ingredients(fsdp_mesh, plan)
+    out = compile_step_with_plan(plan, fsdp_mesh, step, state, batch,
+                                 sidecar=str(tmp_path / "x.bin"))
+    assert out is step
+    assert not os.path.exists(tmp_path / "x.bin")
+
+
+# ---------------------------------------------------------------------------
+# reshard-on-restore (the runtime half of PLAN003)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("restore_devices", [4, 8])
+def test_restore_resharded_across_topologies(tmp_path, devices,
+                                             restore_devices):
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.models.transformer import (
+        init_params, param_specs)
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.sharding import shard_tree
+
+    cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+               d_ff=128, vocab_size=256)
+    save_mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices)
+    params = shard_tree(init_params(cfg, jax.random.key(0)), save_mesh,
+                        param_specs(cfg))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=1,
+                            score_attribute=None)
+    mgr.save(1, params, force=True)
+    mgr.wait()
+
+    # restore on a DIFFERENT topology: shardings re-derived from the
+    # logical spec, not the saved layout — plancheck's PLAN003 pairs
+    # are exactly the (save, restore) combinations this must handle
+    restore_mesh = build_mesh(MeshConfig(data=1, fsdp=restore_devices),
+                              devices[:restore_devices])
+    restored = mgr.restore_resharded(params, restore_mesh,
+                                     param_specs(cfg))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    embed = restored["embed"]
+    assert embed.sharding.mesh.shape["fsdp"] == restore_devices
