@@ -22,6 +22,10 @@ sliding/global, softcaps, tied embeddings — packed seq 4096),
 ``seq4k`` (packed 4k llama-proxy), ``moe`` (Mixtral-pattern 8-expert
 top-2 MoE proxy), ``qwen2-lora`` (full Qwen-2.5-7B dims incl. q/k/v
 bias, NF4 base + LoRA), ``decode`` (KV-cache greedy decode tokens/sec),
+``serve`` (continuous-batching serving A/B, serve/engine.py:
+iteration-level batching across MAX_BATCH slots vs serial batch-1
+greedy over the same request set, with p50/p99 per-token latency,
+batch occupancy and the decode StepCostReport on the record),
 ``input-bound`` (async input pipeline A/B: real packing path behind a
 deliberately slow host stall, prefetch on vs off on one JSON line),
 ``recovery`` (fault drill: time-to-recover from an injected kill +
@@ -883,6 +887,139 @@ def bench_compile():
         compare_baseline=False)
 
 
+def bench_serve():
+    """BENCH_MODE=serve: the continuous-batching engine A/B
+    (serve/engine.py). One JSON line carries BOTH serving throughputs —
+    iteration-level continuous batching across ``MAX_BATCH`` slots vs
+    batch-size-1 serial greedy (the pre-serve comparison path) over the
+    SAME request set; value = the speedup, so the batching win is
+    measured, not asserted. The record also carries p50/p99 per-token
+    latency, mean batch occupancy, slot refill count, and the decode
+    executable's StepCostReport (perf/costs.py) — the numbers that
+    survive the dead accelerator backend."""
+    import dataclasses
+
+    import numpy as np
+
+    from gke_ray_train_tpu.models import (
+        greedy_generate_cached, init_params, llama3_8b)
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.serve.engine import BatchEngine, Request
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    if on_tpu:
+        size = dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+                    d_ff=5504, vocab_size=32768)
+        bucket, max_new = 512, 96
+    else:
+        size = dict(d_model=256, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_ff=512, vocab_size=2048)
+        bucket, max_new = 128, 24
+    # env dialect wins (MAX_BATCH / DECODE_BUCKETS / SERVE_QUANT tune
+    # the A/B without editing this file); backend-sized defaults apply
+    # only for knobs the env leaves unset. AOT stays ON so warm_up()
+    # actually builds the executables — the timed arm must measure
+    # serving, not compilation (and the cost report needs the AOT
+    # executable to introspect).
+    overrides = {"aot_train_step": True}
+    if "MAX_BATCH" not in os.environ:
+        overrides["max_batch"] = 8 if on_tpu else 4
+    if "DECODE_BUCKETS" not in os.environ:
+        overrides["decode_buckets"] = str(bucket)
+    plan = ExecutionPlan.resolve(**overrides)
+    buckets = plan.bucket_list()
+    # the model's window follows the plan: max_seq_len = the LARGEST
+    # declared bucket, so an env DECODE_BUCKETS of any widths just
+    # works (every bucket usable, none silently dropped)
+    cfg = dataclasses.replace(
+        llama3_8b(), name="llama3-serve-bench", max_seq_len=buckets[-1],
+        dtype="bfloat16" if on_tpu else "float32",
+        param_dtype="bfloat16" if on_tpu else "float32",
+        remat=False, **size)
+    params = init_params(cfg, jax.random.key(0))
+    eos_id = 2
+    engine = BatchEngine(params, cfg, plan=plan, eos_ids=(eos_id,))
+    engine.warm_up()
+    cost = engine.decode_cost_report()
+
+    rng = np.random.default_rng(0)
+    n_requests = 4 * engine.max_batch
+    # prompts sized to the SMALLEST bucket so every request is
+    # servable under any env bucket list
+    max_new = min(max_new, max(buckets[-1] - 16, 1))
+    max_prompt = max(buckets[0] - max_new, 16)
+    reqs = [Request(rid=f"r{i}",
+                    token_ids=rng.integers(
+                        3, cfg.vocab_size,
+                        size=int(rng.integers(8, max(max_prompt // 2, 9)))
+                    ).astype(np.int32),
+                    max_new_tokens=max_new)
+            for i in range(n_requests)]
+
+    # arm A: continuous batching (compile excluded via warm_up above)
+    t0 = time.perf_counter()
+    comps = engine.run_until_drained(reqs)
+    dt_cont = max(time.perf_counter() - t0, 1e-9)
+    gen_cont = sum(c.length - c.prompt_len for c in comps)
+    stats = engine.stats()
+
+    # arm B: batch-size-1 serial greedy over the SAME requests (the
+    # sequential oracle the engine is bitwise-tested against)
+    def serial_one(r):
+        # the same bucket the engine routed this request to — the
+        # bitwise-equal premise behind reusing the engine's token
+        # counts holds per bucket width
+        from gke_ray_train_tpu.serve.bucketing import (
+            form_prompt_buffer, pick_bucket)
+        w = pick_bucket(len(r.token_ids), r.max_new_tokens, buckets)
+        buf, _ = form_prompt_buffer(r.token_ids, w)
+        # engine.params, NOT params: with SERVE_QUANT set the engine
+        # serves the quantized tree — the arms must run the same model
+        # or the bitwise-equal premise (and the copied token counts)
+        # breaks
+        out = greedy_generate_cached(
+            engine.params, jnp.asarray(buf),
+            jnp.asarray([len(r.token_ids)], jnp.int32), cfg,
+            max_new_tokens=r.max_new_tokens, eos_ids=(eos_id,))
+        return np.asarray(out[0]), len(r.token_ids)
+
+    serial_one(reqs[0])                     # compile outside the clock
+    t0 = time.perf_counter()
+    for r in reqs:
+        serial_one(r)
+    dt_serial = max(time.perf_counter() - t0, 1e-9)
+    # both arms are bitwise-identical (the drilled contract), so the
+    # engine's exact per-request counts ARE the serial arm's counts —
+    # re-inferring them from the raw buffer (zero can be a legitimate
+    # token id) would bias the A/B
+    gen_serial = gen_cont
+
+    tps_cont = gen_cont / dt_cont / n_dev
+    tps_serial = gen_serial / dt_serial / n_dev
+    _emit(
+        f"serve speedup continuous-batching (batch {engine.max_batch}) "
+        f"vs serial batch-1 greedy ({cfg.d_model}d/{cfg.n_layers}L, "
+        f"buckets {plan.decode_buckets}, {n_requests} requests, "
+        f"{devices[0].device_kind} x{n_dev})",
+        tps_cont / tps_serial, "x",
+        {"continuous_tokens_per_sec_per_chip": round(tps_cont, 1),
+         "serial_tokens_per_sec_per_chip": round(tps_serial, 1),
+         "generated_tokens": int(gen_cont),
+         "max_batch": engine.max_batch,
+         "decode_buckets": plan.decode_buckets,
+         "serve_quant": plan.serve_quant,
+         "p50_token_latency_s": round(stats["p50_token_latency_s"], 5),
+         "p99_token_latency_s": round(stats["p99_token_latency_s"], 5),
+         "batch_occupancy": round(stats["batch_occupancy"], 4),
+         "slot_refills": int(engine.refills),
+         "decode_iterations": int(stats["iterations"]),
+         "decode_cost_report": (cost.summary() if cost is not None
+                                else None)},
+        compare_baseline=False)
+
+
 def bench_decode():
     """KV-cache greedy decode tokens/sec (models/kvcache.py)."""
     import dataclasses
@@ -975,7 +1112,8 @@ def main():
      "input-bound": bench_input_bound,
      "recovery": bench_recovery,
      "compile": bench_compile,
-     "decode": bench_decode}[mode]()
+     "decode": bench_decode,
+     "serve": bench_serve}[mode]()
 
 
 if __name__ == "__main__":

@@ -51,8 +51,8 @@ def _lint(paths: List[str]) -> int:
 
 
 def _preset_names(names: List[str]) -> List[str]:
-    from gke_ray_train_tpu.perf.budget import PRESETS
-    return names or sorted(PRESETS)
+    from gke_ray_train_tpu.perf.budget import all_preset_names
+    return names or all_preset_names()
 
 
 def _plancheck(paths: List[str], budget_dir: str = None) -> int:

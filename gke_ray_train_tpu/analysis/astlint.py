@@ -34,8 +34,9 @@ Suppression syntax (same line as the finding)::
 
     x = batch["n"].item()  # shardlint: disable=TPU001 -- probe path, once
 
-The reachability analysis is name-based and project-local: defs named
-``train_step``/``eval_step``, functions passed to tracing transforms
+The reachability analysis is name-based and project-local: step-named
+defs (``STEP_FN_NAMES`` — the train/eval steps plus the serving
+engine's prefill/decode/insert bodies), functions passed to tracing transforms
 (``jit``/``grad``/``scan``/``shard_map``/``pallas_call``/...), and
 functions decorated with them seed the traced set; the set closes over
 same-named project defs called from traced bodies, and lexically nested
@@ -68,7 +69,13 @@ TRACE_TRANSFORMS = frozenset({
     "shard_map", "pallas_call", "custom_vjp", "custom_jvp", "associative_scan",
 })
 
-STEP_FN_NAMES = frozenset({"train_step", "eval_step"})
+STEP_FN_NAMES = frozenset({
+    "train_step", "eval_step",
+    # the serving engine's jit-reachable bodies (serve/engine.py): they
+    # compile through compile_step_with_plan rather than a literal
+    # jax.jit call site, so name-seeding is what puts the continuous-
+    # batching decode loop under TPU001/TPU004/TPU005
+    "prefill_step", "decode_step", "insert_slot"})
 
 # host-sync callables by resolved dotted path (module aliases resolved)
 HOST_SYNC_PATHS = frozenset({

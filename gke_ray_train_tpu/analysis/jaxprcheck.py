@@ -249,18 +249,64 @@ def donation_findings(compiled, state: Any, *, min_frac: float = 0.8,
 # preset-level check/trace (the CLI's `check` and `trace` verbs)
 # ---------------------------------------------------------------------------
 
-def check_preset(name: str, *, budget_dir: Optional[str] = None
-                 ) -> List[str]:
-    """All level-2 findings for one perf.budget preset: unbudgeted
-    collectives, dropped donation, and any recompile on a second
-    same-signature step call."""
+def check_serve_preset(name: str, *, budget_dir: Optional[str] = None
+                       ) -> List[str]:
+    """Level-2 findings for a serving-decode preset (serve/engine.py):
+    the decode step must stay within its checked-in budget (any
+    collective showing up in the mesh-local decode is a reshard bug),
+    its KV-pool donation must hold, and a second same-signature decode
+    dispatch must be a trace-cache hit — the continuous-batching loop
+    runs it thousands of times per request stream."""
     import os
 
     import jax
 
     from gke_ray_train_tpu.perf.budget import (
-        budget_path, build_preset_step, load_budget)
+        budget_path, build_serve_preset_step, load_budget)
     from gke_ray_train_tpu.perf.costs import step_cost_report
+
+    findings: List[str] = []
+    compiled, params, state, jitted = build_serve_preset_step(
+        name, with_jitted=True)
+
+    report = step_cost_report(compiled)
+    bpath = budget_path(name, budget_dir)
+    if os.path.exists(bpath):
+        findings.extend(unbudgeted_collectives(report, load_budget(bpath)))
+    else:
+        logger.warning("no budget at %s; collective check skipped "
+                       "(run: python -m gke_ray_train_tpu.perf.budget "
+                       "record)", bpath)
+
+    # the serve state (dominated by the [max_batch, bucket] KV pool) is
+    # donated through every decode iteration — a dropped donation
+    # doubles the pool's footprint at exactly max_batch scale
+    findings.extend(donation_findings(compiled, state,
+                                      label=f"{name} decode_step"))
+
+    with RecompileDetector() as det:
+        state1 = jax.block_until_ready(jitted(params, state, None))
+        jax.block_until_ready(jitted(params, state1, None))
+    findings.extend(det.findings())
+    return [f"{name}: {f}" for f in findings]
+
+
+def check_preset(name: str, *, budget_dir: Optional[str] = None
+                 ) -> List[str]:
+    """All level-2 findings for one perf.budget preset: unbudgeted
+    collectives, dropped donation, and any recompile on a second
+    same-signature step call. Serve presets route to
+    :func:`check_serve_preset`."""
+    import os
+
+    import jax
+
+    from gke_ray_train_tpu.perf.budget import (
+        SERVE_PRESETS, budget_path, build_preset_step, load_budget)
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+
+    if name in SERVE_PRESETS:
+        return check_serve_preset(name, budget_dir=budget_dir)
 
     findings: List[str] = []
 
@@ -299,15 +345,21 @@ def check_preset(name: str, *, budget_dir: Optional[str] = None
 def trace_preset(name: str) -> str:
     """Human-readable level-2 report for one preset (the CLI `trace`
     verb): the cost ledger + donation + collective census."""
-    from gke_ray_train_tpu.perf.budget import build_preset_step
+    from gke_ray_train_tpu.perf.budget import (
+        SERVE_PRESETS, build_preset_step, build_serve_preset_step)
     from gke_ray_train_tpu.perf.costs import step_cost_report
 
-    compiled, state, _ = build_preset_step(name, donate=True)
+    if name in SERVE_PRESETS:
+        compiled, _, state = build_serve_preset_step(name)
+        label = "decode_step"
+    else:
+        compiled, state, _ = build_preset_step(name, donate=True)
+        label = "train_step"
     report = step_cost_report(compiled)
     lines = [f"== {name} =="]
     for k, v in sorted(report.summary().items()):
         lines.append(f"  {k}: {v}")
-    don = donation_findings(compiled, state, label="train_step")
+    don = donation_findings(compiled, state, label=label)
     lines.append("  donation: " + (don[0] if don else "held"))
     for hlo in report.collective_lines:
         lines.append(f"  HLO {hlo}")

@@ -178,13 +178,14 @@ def budget_findings(plan: ExecutionPlan, *,
     if plan.budget_preset is None:
         return []
     from gke_ray_train_tpu.perf.budget import (
-        PRESETS, budget_path, load_budget, plan_for_preset)
+        PRESETS, SERVE_PRESETS, all_preset_names, budget_path,
+        load_budget, plan_for_preset)
     name = plan.budget_preset
-    if name not in PRESETS:
+    if name not in PRESETS and name not in SERVE_PRESETS:
         return [PlanFinding(
             "PLAN004", "BUDGET_PRESET",
-            f"unknown budget preset {name!r}; known: {sorted(PRESETS)}",
-            label)]
+            f"unknown budget preset {name!r}; known: "
+            f"{all_preset_names()}", label)]
     path = budget_path(name, budget_dir)
     if not os.path.exists(path):
         return [PlanFinding(
@@ -237,10 +238,11 @@ def repo_budget_findings(budget_dir: Optional[str] = None
     """PLAN004, repo level: every checked-in budget JSON matches the
     fingerprint of the preset plan that would re-record it."""
     from gke_ray_train_tpu.perf.budget import (
-        BUDGET_DIR, PRESETS, budget_path, load_budget, plan_for_preset)
+        BUDGET_DIR, all_preset_names, budget_path, load_budget,
+        plan_for_preset)
     out: List[PlanFinding] = []
     bdir = budget_dir or BUDGET_DIR
-    for name in sorted(PRESETS):
+    for name in all_preset_names():
         path = budget_path(name, bdir)
         if not os.path.exists(path):
             continue   # unrecorded presets are perf.budget's business

@@ -45,6 +45,9 @@ PLAN_SCOPED_KEYS = frozenset({
     "COMPILE_CACHE", "COMPILE_CACHE_DIR", "AOT_TRAIN_STEP",
     # runtime guards (analysis/guards.py)
     "TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
+    # serving shape (serve/engine.py): slot count, length buckets,
+    # served-weight quantization
+    "MAX_BATCH", "DECODE_BUCKETS", "SERVE_QUANT",
     # identity: declared chip topology + pinned cost budget
     "TOPOLOGY", "BUDGET_PRESET",
 })
@@ -74,6 +77,9 @@ KNOWN_KEYS = frozenset({
     # inference comparison
     "INFERENCE", "NUM_EVAL_SAMPLES_INFERENCE",
     "MAX_NEW_GENERATION_TOKENS_INFERENCE",
+    # post-train serving smoke (serve/engine.py): run the comparison
+    # prompts through the continuous-batching engine after training
+    "SERVE_AFTER_TRAIN",
     # TPU / model-numerics extensions (the plan owns the mesh keys)
     "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
     "SMOKE_TEST",

@@ -30,7 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import os
-from functools import lru_cache
+from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -42,18 +42,33 @@ from gke_ray_train_tpu.data.sft import format_gretel_sql_example, render_chat
 from gke_ray_train_tpu.models.config import ModelConfig
 from gke_ray_train_tpu.models.kvcache import greedy_generate_cached
 from gke_ray_train_tpu.models.transformer import Params
+from gke_ray_train_tpu.serve.bucketing import (
+    form_prompt_buffer, prompt_bucket, truncate_prompt)
 
 logger = logging.getLogger(__name__)
 
+# jitted replicated-generate executables keyed on (mesh identity, cfg,
+# decode shape). NOT an lru_cache: every entry closes over a
+# NamedSharding that pins its Mesh — and through it the device buffers
+# of every array the jit ever touched — so an unbounded/function-scoped
+# cache kept torn-down meshes alive for the life of the process. The
+# id(mesh) key is stable exactly because the entry pins the mesh (no id
+# reuse while the entry lives); eviction and clear_generate_cache()
+# are what release it.
+_GENERATE_CACHE: "OrderedDict[Tuple, Callable]" = OrderedDict()
+_GENERATE_CACHE_MAX = 32
 
-def _prompt_bucket(n: int, *, bucket: int = 128) -> int:
-    """Round the prompt region up to a fixed bucket so every prompt of
-    similar length shares one compiled decode loop (VERDICT r1 weak #6:
-    per-prompt-length recompiles)."""
-    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+def clear_generate_cache() -> int:
+    """Drop every cached replicated-generate executable — call on mesh
+    teardown (``rayint/trainer.py`` does, after every worker attempt):
+    the cache is the only thing keeping a dead mesh's device buffers
+    live. Returns the number of entries dropped."""
+    n = len(_GENERATE_CACHE)
+    _GENERATE_CACHE.clear()
+    return n
 
 
-@lru_cache(maxsize=32)
 def _replicated_generate(mesh: Mesh, cfg: ModelConfig,
                          max_new_tokens: int, eos_ids: Tuple[int, ...],
                          lora_scale: float):
@@ -61,6 +76,11 @@ def _replicated_generate(mesh: Mesh, cfg: ModelConfig,
     pinned to a replicated sharding, so every host can read its full
     value from any addressable shard. The inner call traces through the
     already-jitted greedy_generate_cached."""
+    key = (id(mesh), cfg, max_new_tokens, eos_ids, lora_scale)
+    fn = _GENERATE_CACHE.get(key)
+    if fn is not None:
+        _GENERATE_CACHE.move_to_end(key)
+        return fn
     out_sharding = NamedSharding(mesh, P())
 
     def f(params, prompt, prompt_len, lora):
@@ -68,7 +88,11 @@ def _replicated_generate(mesh: Mesh, cfg: ModelConfig,
             params, prompt, prompt_len, cfg,
             max_new_tokens=max_new_tokens, eos_ids=eos_ids,
             lora=lora, lora_scale=lora_scale)
-    return jax.jit(f, out_shardings=out_sharding)
+    fn = jax.jit(f, out_shardings=out_sharding)
+    _GENERATE_CACHE[key] = fn
+    while len(_GENERATE_CACHE) > _GENERATE_CACHE_MAX:
+        _GENERATE_CACHE.popitem(last=False)
+    return fn
 
 
 def _place_replicated(mesh: Mesh, arr: np.ndarray) -> jax.Array:
@@ -89,18 +113,18 @@ def generate_answer(params: Params, cfg: ModelConfig, tokenizer,
         np.int32)
     # bucketed fixed-size buffer: prompt region rounded up to a 128
     # multiple + generation room — compiles once per bucket, not per
-    # prompt length
+    # prompt length. Bucketing/truncation/form-up are shared with the
+    # serving engine (serve/bucketing.py) so the two paths cannot drift;
+    # an over-long prompt is truncated LOUDLY (the head is dropped).
     max_prompt = max(cfg.max_seq_len - max_new_tokens, 1)
-    if len(ids) > max_prompt:
-        ids = ids[-max_prompt:]
+    ids = truncate_prompt(ids, max_prompt, label="generate_answer prompt")
     # buffer width rounded to a 128 multiple: the KV-cache flash prefill
     # gates on the CACHE width tiling too (models/kvcache.py) — an
     # unaligned width would silently fall back to the dense
     # O(T*max_len) prefill at exactly the long-prompt sizes where it
     # hurts. One bucket call keeps compile-sharing per length class.
-    L = min(_prompt_bucket(len(ids) + max_new_tokens), cfg.max_seq_len)
-    buf = np.zeros((1, L), np.int32)
-    buf[0, :len(ids)] = ids
+    L = min(prompt_bucket(len(ids) + max_new_tokens), cfg.max_seq_len)
+    buf, _ = form_prompt_buffer(ids, L)
     eos_ids = []
     if getattr(tokenizer, "eos_token_id", None) is not None:
         eos_ids.append(int(tokenizer.eos_token_id))

@@ -55,6 +55,22 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
                        for _ in cfg.block_pattern]}
 
 
+def insert_cache_slot(pool: Cache, slot: jnp.ndarray, row: Cache) -> Cache:
+    """Write a batch=1 cache ``row`` into batch index ``slot`` of a
+    pooled cache — the continuous-batching admit path (serve/engine.py):
+    a freshly prefilled request takes over a finished sequence's slot
+    without touching any other slot's K/V bytes (pure
+    ``dynamic_update_slice`` along the batch axis, so the surviving
+    sequences' attention inputs are bit-identical before and after).
+
+    ``slot`` may be a traced scalar — one compiled insert serves every
+    slot index."""
+    def upd(p, r):
+        return jax.lax.dynamic_update_slice_in_dim(
+            p, r.astype(p.dtype), slot, axis=1)
+    return jax.tree.map(upd, pool, row)
+
+
 def _scatter_rows(cache_kv: jnp.ndarray, new_kv: jnp.ndarray,
                   lens: jnp.ndarray) -> jnp.ndarray:
     """Write new_kv [B, T, K, hd] into cache_kv [B, max_len, K, hd] at

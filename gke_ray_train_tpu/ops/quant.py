@@ -194,6 +194,25 @@ def quantize_params(params: Any, kind: str = "nf4",
     return rec(params)
 
 
+SERVE_QUANT_KINDS = ("none", "int8", "nf4")
+
+
+def quantize_for_serving(params: Any, kind: str,
+                         group: int = DEFAULT_GROUP) -> Any:
+    """The serving engine's weight-encoding hook (serve/engine.py):
+    ``"none"`` passes the tree through untouched (serve whatever dtype
+    the checkpoint holds); ``"int8"``/``"nf4"`` quantize the projection
+    targets in place — already-quantized leaves (a QLoRA base) are left
+    as they are, so a quantized training artifact round-trips."""
+    kind = (kind or "none").strip().lower()
+    if kind == "none":
+        return params
+    if kind not in SERVE_QUANT_KINDS:
+        raise ValueError(f"serve quant kind {kind!r}; use "
+                         f"{'|'.join(SERVE_QUANT_KINDS)}")
+    return quantize_params(params, kind=kind, group=group)
+
+
 def quant_specs(specs: Any, params: Any, mesh=None) -> Any:
     """Spec tree matching a quantized param tree: QTensor codes reuse the
     weight's spec; scales reuse it too except on dims too small to shard

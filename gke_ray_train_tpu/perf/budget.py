@@ -63,7 +63,7 @@ def write_budget(report: Union[StepCostReport, Dict[str, Any]], path: str,
     if isinstance(report, StepCostReport):
         report = report.to_dict()
     import jax
-    if plan is None and preset in PRESETS:
+    if plan is None and (preset in PRESETS or preset in SERVE_PRESETS):
         plan = plan_for_preset(preset)
     doc = {
         "_preset": preset,
@@ -207,6 +207,79 @@ PRESETS = {
 }
 
 
+@dataclasses.dataclass(frozen=True)
+class ServePreset:
+    """A serving-decode budget shape: the ``[max_batch, 1]`` continuous-
+    batching decode step of ``serve/engine.py`` at one bucket width.
+    Mesh-local by design (a serving replica's decode carries no
+    collectives — an all-gather showing up here IS the regression the
+    exact-count check exists to catch)."""
+    name: str
+    max_batch: int = 8
+    bucket: int = 128
+    quant: str = "none"
+
+
+SERVE_PRESETS = {
+    "serve_tiny8": ServePreset("serve_tiny8"),
+}
+
+
+def all_preset_names() -> List[str]:
+    """Every budget-bearing preset (train + serve) — the CLI default
+    and the repo-level PLAN004 sweep iterate exactly this list."""
+    return sorted(PRESETS) + sorted(SERVE_PRESETS)
+
+
+def _serve_model_cfg(p: ServePreset):
+    """The deterministic tiny model a serve preset decodes (same dims
+    the train presets use, max_seq_len = the bucket width)."""
+    from gke_ray_train_tpu.models import tiny
+    return tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+                d_ff=128, vocab_size=256, max_seq_len=p.bucket,
+                remat=False)
+
+
+def plan_for_serve_preset(preset: Union[str, ServePreset]):
+    """The serving ExecutionPlan a serve budget measures under — one
+    plan fingerprint shared by the budget JSON, plancheck PLAN004 and
+    ``analysis check`` (mirror of :func:`plan_for_preset`)."""
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    p = SERVE_PRESETS[preset] if isinstance(preset, str) else preset
+    return ExecutionPlan.from_kwargs(
+        data=1, fsdp=1, max_seq_len=p.bucket,
+        max_batch=p.max_batch, decode_buckets=str(p.bucket),
+        serve_quant=p.quant,
+        donate_state=False, donate_batch=False, prefetch=0,
+        compile_cache=False, aot_train_step=False,
+        topology="cpu-8", budget_preset=p.name)
+
+
+def build_serve_preset_step(preset: Union[str, ServePreset], *,
+                            with_jitted: bool = False):
+    """(compiled_decode, params, serve_state) for a serve preset — the
+    deterministic decode compile whose StepCostReport the budget pins.
+    ``with_jitted`` additionally returns the jitted (un-AOT) decode fn
+    for the analysis compile-once probe."""
+    import jax
+
+    from gke_ray_train_tpu.models import init_params
+    from gke_ray_train_tpu.ops.quant import quantize_for_serving
+    from gke_ray_train_tpu.serve.engine import (
+        init_serve_state, make_decode_fn)
+
+    p = SERVE_PRESETS[preset] if isinstance(preset, str) else preset
+    cfg = _serve_model_cfg(p)
+    params = quantize_for_serving(init_params(cfg, jax.random.key(0)),
+                                  p.quant)
+    state = init_serve_state(cfg, p.max_batch, p.bucket)
+    jitted = jax.jit(make_decode_fn(cfg, eos_ids=()), donate_argnums=(1,))
+    compiled = jitted.lower(params, state, None).compile()
+    if with_jitted:
+        return compiled, params, state, jitted
+    return compiled, params, state
+
+
 def plan_for_preset(preset: Union[str, "Preset"]):
     """The ExecutionPlan a budget preset measures under — the SAME plan
     object ``analysis check`` and the budget CLI consume, so one
@@ -215,8 +288,12 @@ def plan_for_preset(preset: Union[str, "Preset"]):
 
     Measurement policy is part of the identity: budgets are recorded
     donate=False (backend-independent numbers) with no input pipeline
-    or guards, on the canonical 8-fake-device CPU mesh."""
+    or guards, on the canonical 8-fake-device CPU mesh. Serve presets
+    (``SERVE_PRESETS``) route to :func:`plan_for_serve_preset`."""
     from gke_ray_train_tpu.plan import ExecutionPlan
+    if isinstance(preset, ServePreset) or (
+            isinstance(preset, str) and preset in SERVE_PRESETS):
+        return plan_for_serve_preset(preset)
     p = PRESETS[preset] if isinstance(preset, str) else preset
     mesh = {axis: p.mesh.get(axis, 1)
             for axis in ("data", "fsdp", "model", "context", "pipe")}
@@ -279,8 +356,14 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
     return compiled, state, batch
 
 
-def build_preset_report(preset: Union[str, Preset],
+def build_preset_report(preset: Union[str, Preset, ServePreset],
                         *, remat=None) -> StepCostReport:
+    if isinstance(preset, ServePreset) or (
+            isinstance(preset, str) and preset in SERVE_PRESETS):
+        p = SERVE_PRESETS[preset] if isinstance(preset, str) else preset
+        compiled, _, _ = build_serve_preset_step(p)
+        # one decode iteration emits one token per slot
+        return step_cost_report(compiled, tokens_per_step=p.max_batch)
     p = PRESETS[preset] if isinstance(preset, str) else preset
     compiled, _, _ = build_preset_step(p, remat=remat)
     return step_cost_report(compiled, tokens_per_step=p.batch * p.seq)
@@ -312,7 +395,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("command", choices=("record", "check"))
     parser.add_argument("names", nargs="*",
                         help=f"presets (default: all of "
-                             f"{sorted(PRESETS)})")
+                             f"{all_preset_names()})")
     parser.add_argument("--dir", default=BUDGET_DIR,
                         help="budget directory (default tests/budgets)")
     args = parser.parse_args(argv)
@@ -323,7 +406,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import jax
     assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, \
         "budget CLI must run on the 8-fake-device CPU mesh"
-    names = args.names or sorted(PRESETS)
+    names = args.names or all_preset_names()
     rc = 0
     for name in names:
         plan = plan_for_preset(name)

@@ -60,6 +60,14 @@ CHIP_COUNTS: Dict[str, int] = {
 _TRANSFER_GUARD_MODES = (None, "log", "disallow")
 
 
+def _serve_quant_kinds() -> Tuple[str, ...]:
+    """ops/quant.py owns the serving quantization vocabulary; imported
+    lazily (validation time only) so plan.py stays importable without
+    pulling the jax-heavy ops package at module load."""
+    from gke_ray_train_tpu.ops.quant import SERVE_QUANT_KINDS
+    return tuple(SERVE_QUANT_KINDS)
+
+
 def _as_bool(v: Any, field: str) -> bool:
     if isinstance(v, bool):
         return v
@@ -120,6 +128,18 @@ class ExecutionPlan:
     recompile_limit: int = 0                  # 0 = off
     divergence_guard: bool = False
 
+    # -- serving shape (serve/engine.py) --------------------------------
+    # slot count of the continuous-batching engine: every decode
+    # executable compiles at exactly [max_batch, 1]
+    max_batch: int = 8
+    # request length buckets (comma string, normalized ascending): a
+    # request lands in the smallest bucket >= prompt_len + max_new, and
+    # prefill/decode compile once per bucket. 128-multiples keep the
+    # flash-prefill gate (models/kvcache.py) able to engage.
+    decode_buckets: str = "256,512"
+    # weight quantization the replica serves: "none" | "int8" | "nf4"
+    serve_quant: str = "none"
+
     # -- identity --------------------------------------------------------
     topology: str = "cpu-8"                   # key into CHIP_COUNTS
     budget_preset: Optional[str] = None       # tests/budgets/<name>.json
@@ -145,6 +165,12 @@ class ExecutionPlan:
             raise PlanError(
                 f"transfer_guard={self.transfer_guard!r} not in "
                 f"{_TRANSFER_GUARD_MODES}")
+        if self.max_batch < 1:
+            raise PlanError(f"max_batch={self.max_batch} must be >= 1")
+        self.bucket_list()   # validates decode_buckets
+        if self.serve_quant not in _serve_quant_kinds():
+            raise PlanError(f"serve_quant={self.serve_quant!r} not in "
+                            f"{_serve_quant_kinds()}")
         if self.topology not in CHIP_COUNTS:
             raise PlanError(f"topology={self.topology!r} unknown; "
                             f"presets: {sorted(CHIP_COUNTS)}")
@@ -289,6 +315,22 @@ class ExecutionPlan:
         from jax.sharding import PartitionSpec as P
         return P(BATCH_AXES,
                  "context" if self.context_sharded else None)
+
+    def bucket_list(self) -> Tuple[int, ...]:
+        """``decode_buckets`` parsed to ascending unique ints — the
+        lengths the serving engine compiles prefill/decode pairs for."""
+        try:
+            vals = tuple(sorted({int(tok) for tok in
+                                 str(self.decode_buckets).split(",")
+                                 if str(tok).strip()}))
+        except ValueError:
+            raise PlanError(
+                f"decode_buckets={self.decode_buckets!r} is not a "
+                "comma-separated int list") from None
+        if not vals or any(v < 1 for v in vals):
+            raise PlanError(f"decode_buckets={self.decode_buckets!r} "
+                            "must name at least one length >= 1")
+        return vals
 
     def batch_keys(self) -> Tuple[str, ...]:
         return ("inputs", "targets", "weights") + (
@@ -444,6 +486,9 @@ CONFIG_KEYS: Dict[str, str] = {
     "transfer_guard": "TRANSFER_GUARD",
     "recompile_limit": "RECOMPILE_LIMIT",
     "divergence_guard": "DIVERGENCE_GUARD",
+    "max_batch": "MAX_BATCH",
+    "decode_buckets": "DECODE_BUCKETS",
+    "serve_quant": "SERVE_QUANT",
     "topology": "TOPOLOGY",
     "budget_preset": "BUDGET_PRESET",
 }
@@ -456,7 +501,11 @@ COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
     "data", "fsdp", "model", "context", "pipe", "num_slices",
     "pipe_microbatches", "pipe_virtual_stages",
     "per_device_batch", "grad_accum", "max_seq_len", "packing",
-    "donate_state", "donate_batch")
+    "donate_state", "donate_batch",
+    # serving shape: slot count / bucket widths / weight encoding all
+    # change the prefill+decode programs the engine compiles, so they
+    # must invalidate serve sidecars and split the compile cache
+    "max_batch", "decode_buckets", "serve_quant")
 
 # plan knobs the trainer forwards from the driver env to Ray workers
 # (rayint/trainer.py) — derived from the mapping so a renamed knob
@@ -474,7 +523,7 @@ _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",
                          "grad_accum", "max_seq_len", "prefetch",
-                         "recompile_limit"})
+                         "recompile_limit", "max_batch"})
 
 
 def _coerce(field: str, value: Any) -> Any:
@@ -494,6 +543,21 @@ def _coerce(field: str, value: Any) -> Any:
         return str(value) if value is not None else None
     if field == "topology":
         return str(value).strip().lower()
+    if field == "decode_buckets":
+        # JSON lists, "512,256" strings and bare ints all normalize to
+        # one canonical ascending comma string, so the three dialects
+        # fingerprint identically
+        toks = (value if isinstance(value, (list, tuple))
+                else str(value).split(","))
+        try:
+            vals = sorted({int(str(t).strip()) for t in toks
+                           if str(t).strip()})
+        except ValueError:
+            raise PlanError(f"decode_buckets={value!r} is not a "
+                            "comma-separated int list") from None
+        return ",".join(str(v) for v in vals)
+    if field == "serve_quant":
+        return str(value).strip().lower() or "none"
     return value
 
 

@@ -4,3 +4,5 @@ from gke_ray_train_tpu.rayint.context import (  # noqa: F401
     get_context, report)
 from gke_ray_train_tpu.rayint.supervisor import (  # noqa: F401
     HeartbeatBoard, HeartbeatTimeout, Supervisor, Watchdog)
+from gke_ray_train_tpu.rayint.serving import (  # noqa: F401
+    ServeDeployment, ServeReplica)

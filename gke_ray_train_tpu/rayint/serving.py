@@ -1,0 +1,233 @@
+"""Ray-actor serving deployment — replica-per-host-group continuous
+batching over the trained artifacts (ROADMAP #2, the "millions of
+users" half).
+
+One :class:`ServeReplica` actor per TPU host-group, each owning one
+:class:`~gke_ray_train_tpu.serve.engine.BatchEngine` (the replica's
+whole device set runs the bucketed prefill/decode executables; a JAX
+process drives all its local chips, exactly like a training worker).
+The driver-side :class:`ServeDeployment` scatters request batches
+round-robin across replicas and gathers completions; each replica
+continuously batches its share at iteration granularity.
+
+Liveness rides the existing supervisor heartbeat shape
+(``rayint/supervisor.py``): every engine iteration beats
+``(replica_rank, iteration)`` to a Supervisor actor (Ray path) or an
+in-process HeartbeatBoard (local path), so a replica wedged mid-decode
+is detected — and NAMED — by the same board that watches training
+ranks. Cold start reuses the AOT sidecar dir (``perf/cache.py`` via the
+engine): point every replica at shared storage and a fresh process
+deserializes its prefill/decode executables instead of compiling.
+
+Ray is optional at import time (the trainer's pattern): with no Ray
+installed or ``use_ray=False`` the deployment degrades to in-process
+replicas — that is also the unit-test path; the fake-ray harness in
+``tests/test_rayint_cluster.py`` drives the actor path.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - exercised only with Ray installed
+    import ray
+    _HAS_RAY = True
+except ImportError:
+    ray = None
+    _HAS_RAY = False
+
+
+def _completion_payload(c) -> Dict[str, Any]:
+    """A Completion as a plain dict — actor results must cross process
+    boundaries without importing serve/ on the driver."""
+    return {
+        "rid": c.rid,
+        "tokens": np.asarray(c.tokens).tolist(),
+        "generated": np.asarray(c.generated).tolist(),
+        "prompt_len": int(c.prompt_len),
+        "length": int(c.length),
+        "bucket": int(c.bucket),
+        "finish_reason": c.finish_reason,
+        "first_token_s": float(c.first_token_s),
+        "done_s": float(c.done_s),
+    }
+
+
+class ServeReplica:
+    """Actor body: ``ray.remote(ServeReplica)`` at deploy time (the
+    ``rayint/supervisor.py::Supervisor`` pattern — decorating here
+    would make Ray an import-time dependency). Zero-arg constructible;
+    :meth:`build` does the heavy lifting so actor creation stays cheap
+    and the engine factory travels as a task argument."""
+
+    def __init__(self):
+        self._engine = None
+        self._rank = 0
+
+    def build(self, engine_factory: Callable[[], Any], *, rank: int = 0,
+              supervisor=None, warm: bool = True) -> Dict[str, Any]:
+        """Construct (and by default warm up) this replica's engine.
+        ``engine_factory() -> BatchEngine`` loads weights and plan on
+        the replica's own process; with a ``supervisor`` handle every
+        engine iteration beats ``(rank, iteration)`` to it. Returns
+        ``executable_info()`` — the cold-start witness (every entry
+        ``"deserialized"`` on a warm sidecar dir)."""
+        self._rank = int(rank)
+        self._engine = engine_factory()
+        if supervisor is not None:
+            if hasattr(supervisor, "beat") and hasattr(
+                    getattr(supervisor, "beat"), "remote"):
+                self._engine.set_heartbeat(
+                    lambda it: supervisor.beat.remote(self._rank, it))
+            else:  # local path: a HeartbeatBoard
+                self._engine.set_heartbeat(
+                    lambda it: supervisor.beat(self._rank, it))
+        if warm:
+            self._engine.warm_up()
+        return self._engine.executable_info()
+
+    def serve(self, requests: Sequence[Dict[str, Any]]
+              ) -> List[Dict[str, Any]]:
+        """Continuously batch ``requests`` (dicts: rid / token_ids /
+        max_new_tokens) to completion; returns completion payloads in
+        submit order."""
+        from gke_ray_train_tpu.serve.engine import Request
+        reqs = [Request(rid=str(r["rid"]),
+                        token_ids=np.asarray(r["token_ids"], np.int32),
+                        max_new_tokens=int(r.get("max_new_tokens", 32)))
+                for r in requests]
+        return [_completion_payload(c)
+                for c in self._engine.run_until_drained(reqs)]
+
+    def stats(self) -> Dict[str, Any]:
+        return self._engine.stats()
+
+    def executable_info(self) -> Dict[str, Any]:
+        return self._engine.executable_info()
+
+
+class ServeDeployment:
+    """Driver-side deployment: N replicas + one heartbeat sink.
+
+    ``engine_factory`` must be self-contained (load checkpoint, build
+    the plan, construct the BatchEngine) — on the Ray path it executes
+    inside each replica actor's process. ``resources_per_replica``
+    follows the trainer's host-group convention (e.g. ``{"TPU": 4}``).
+    """
+
+    def __init__(self, engine_factory: Callable[[], Any], *,
+                 num_replicas: int = 1,
+                 resources_per_replica: Optional[Dict[str, float]] = None,
+                 use_ray: Optional[bool] = None):
+        self.engine_factory = engine_factory
+        self.num_replicas = int(num_replicas)
+        self.resources = resources_per_replica or {}
+        self.use_ray = _HAS_RAY if use_ray is None else use_ray
+        self._replicas: List[Any] = []
+        self._supervisor = None
+        self._board = None
+        self._rr = 0
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, *, warm: bool = True) -> List[Dict[str, Any]]:
+        """Create supervisor + replicas and build every engine. Returns
+        one ``executable_info()`` dict per replica."""
+        if self.use_ray:
+            from gke_ray_train_tpu.rayint.supervisor import Supervisor
+            if not ray.is_initialized():  # pragma: no cover - cluster
+                ray.init()
+            self._supervisor = ray.remote(Supervisor).options(
+                num_cpus=0).remote()
+            actor_cls = ray.remote(ServeReplica)
+            opts = {"resources": self.resources} if self.resources else {}
+            self._replicas = [actor_cls.options(**opts).remote()
+                              for _ in range(self.num_replicas)]
+            infos = ray.get([
+                r.build.remote(self.engine_factory, rank=i,
+                               supervisor=self._supervisor, warm=warm)
+                for i, r in enumerate(self._replicas)])
+        else:
+            from gke_ray_train_tpu.rayint.supervisor import HeartbeatBoard
+            self._board = HeartbeatBoard()
+            self._replicas = [ServeReplica()
+                              for _ in range(self.num_replicas)]
+            infos = [r.build(self.engine_factory, rank=i,
+                             supervisor=self._board, warm=warm)
+                     for i, r in enumerate(self._replicas)]
+        logger.info("serve deployment up: %d replica(s), %s",
+                    self.num_replicas,
+                    "ray actors" if self.use_ray else "in-process")
+        return infos
+
+    def shutdown(self) -> None:
+        if self.use_ray:
+            for actor in self._replicas + (
+                    [self._supervisor] if self._supervisor is not None
+                    else []):
+                try:
+                    ray.kill(actor)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+        self._replicas = []
+        self._supervisor = None
+        self._board = None
+
+    # -- request path --------------------------------------------------
+
+    def serve(self, requests: Sequence[Dict[str, Any]]
+              ) -> List[Dict[str, Any]]:
+        """Scatter a request batch round-robin across replicas, gather
+        completions back in the callers' order. Each replica
+        continuously batches its share; replicas run concurrently on
+        the Ray path (one in-flight ``serve`` task per replica)."""
+        if not self._replicas:
+            raise RuntimeError("deployment not started — call start()")
+        # duplicate rids must fail HERE: scattered onto different
+        # replicas they would dodge the engine's per-rid guard and the
+        # order map below would silently drop one completion
+        rids = [str(r["rid"]) for r in requests]
+        if len(set(rids)) != len(rids):
+            dupes = sorted({r for r in rids if rids.count(r) > 1})
+            raise ValueError(f"duplicate request rids {dupes} — rids "
+                             "must be unique per batch")
+        shares: List[List[Dict[str, Any]]] = [
+            [] for _ in self._replicas]
+        order: Dict[str, int] = {}
+        for i, req in enumerate(requests):
+            shares[(self._rr + i) % len(self._replicas)].append(req)
+            order[str(req["rid"])] = i
+        self._rr = (self._rr + len(requests)) % len(self._replicas)
+        if self.use_ray:
+            futs = [r.serve.remote(share)
+                    for r, share in zip(self._replicas, shares) if share]
+            batches = ray.get(futs)
+        else:
+            batches = [r.serve(share)
+                       for r, share in zip(self._replicas, shares)
+                       if share]
+        out: List[Optional[Dict[str, Any]]] = [None] * len(requests)
+        for batch in batches:
+            for payload in batch:
+                out[order[payload["rid"]]] = payload
+        return [p for p in out if p is not None]
+
+    # -- health --------------------------------------------------------
+
+    def stalled(self, timeout_s: float):
+        """Replicas with no engine-iteration progress for ``timeout_s``
+        — same StallInfo shape the training watchdog reports."""
+        if self.use_ray:
+            return ray.get(self._supervisor.stalled.remote(timeout_s)) \
+                if self._supervisor is not None else []
+        return self._board.stalled(timeout_s) if self._board else []
+
+    def stats(self) -> List[Dict[str, Any]]:
+        if self.use_ray:
+            return ray.get([r.stats.remote() for r in self._replicas])
+        return [r.stats() for r in self._replicas]

@@ -241,6 +241,16 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         # worker must never be reported as stalled
         ctx.heartbeat_done()
         uninstall_recompile_limit()
+        # mesh teardown: the attempt's mesh dies with the attempt, and
+        # the replicated-generate cache is the one thing that would
+        # keep its device buffers alive across retries. sys.modules
+        # guard, NOT an import: the cache can only be non-empty if
+        # inference was already imported, and a fresh import inside
+        # this finally could raise over the attempt's REAL error
+        import sys
+        inf_mod = sys.modules.get("gke_ray_train_tpu.inference")
+        if inf_mod is not None:
+            inf_mod.clear_generate_cache()
         # restore the default SIGTERM disposition: outside an attempt
         # nothing reads the preemption flag, and a long-lived driver
         # process must not silently swallow termination

@@ -1,0 +1,14 @@
+"""serve/ — continuous-batching inference on the trained artifacts.
+
+The production half of the north star (`ROADMAP` #2): promote the
+one-prompt-at-a-time comparison path (``inference.py`` +
+``models/kvcache.py``) into a multi-tenant serving engine —
+iteration-level continuous batching (Orca) over a length-bucketed
+KV-cache pool, adapted to XLA's static-shape world with fixed
+``(max_batch, bucket)`` executables instead of dynamic pages.
+"""
+
+from gke_ray_train_tpu.serve.bucketing import (  # noqa: F401
+    form_prompt_buffer, pick_bucket, prompt_bucket, truncate_prompt)
+from gke_ray_train_tpu.serve.engine import (  # noqa: F401
+    BatchEngine, Completion, Request, post_train_smoke, serve_plan)

@@ -1,0 +1,622 @@
+"""Continuous-batching inference engine (ROADMAP #2, Orca-style).
+
+Iteration-level scheduling over bucketed, jitted executables:
+
+- Every request is assigned the smallest declared length bucket that
+  fits ``prompt_len + max_new_tokens`` (serve/bucketing.py). Per bucket
+  the engine compiles exactly THREE executables — ``prefill_step``
+  (``[1, L]``), ``decode_step`` (``[max_batch, 1]``) and
+  ``insert_slot`` — so XLA compiles once per bucket, never per request.
+- Admission is slot-level: a finished sequence's slot is refilled at
+  the next decode iteration (prefill the newcomer at batch 1, then
+  ``dynamic_update_slice`` its KV rows into the pooled cache) without
+  flushing the batch — the surviving sequences' K/V bytes are
+  untouched, which is what makes continuous-batched output bitwise
+  identical to sequential ``greedy_generate_cached``.
+- The KV pool is ``models/kvcache.py::init_cache`` at
+  ``[max_batch, bucket]`` per bucket — the static-shape stand-in for
+  vLLM's dynamic pages (XLA cannot page, it CAN bucket).
+- Weights optionally serve quantized (``ops/quant.py``: int8/nf4).
+- Cold start: executables build through ``compile_step_with_plan``
+  (plan.py), so the persistent compile cache applies and — when a
+  ``sidecar_dir`` is given — each executable AOT-serializes through
+  ``perf/cache.py``; a fresh replica deserializes all three per bucket
+  and reaches its first decoded token with zero new compilations.
+
+Sequential-equivalence contract (drilled in tests/test_serve.py): the
+per-slot update rule is exactly ``greedy_generate_cached``'s loop body,
+attention masking contributes *exact zeros* for other slots' garbage
+(ops/attention.py NEG_INF underflows), and prefill runs the full bucket
+width — which equals the oracle's internal prefill width whenever the
+bucket is a 128-multiple and ``max_new_tokens < 128``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gke_ray_train_tpu.models.config import ModelConfig
+from gke_ray_train_tpu.models.kvcache import (
+    forward_step, init_cache, insert_cache_slot)
+from gke_ray_train_tpu.ops.quant import quantize_for_serving
+from gke_ray_train_tpu.plan import ExecutionPlan, compile_step_with_plan
+from gke_ray_train_tpu.serve.bucketing import (
+    form_prompt_buffer, pick_bucket, truncate_prompt)
+
+logger = logging.getLogger(__name__)
+
+
+def serve_plan(**overrides: Any) -> ExecutionPlan:
+    """The serving ExecutionPlan: env/config resolved like the trainer's
+    (MAX_BATCH / DECODE_BUCKETS / SERVE_QUANT et al.), with kwarg
+    overrides — so the engine shares the plan fingerprint/budget/
+    plancheck machinery instead of growing a fifth knob dialect."""
+    return ExecutionPlan.resolve(**overrides)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``token_ids`` is the already-tokenized
+    prompt (the engine is tokenizer-agnostic; rayint/serving.py holds
+    the tokenizer)."""
+    rid: str
+    token_ids: np.ndarray
+    max_new_tokens: int = 32
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: str
+    tokens: np.ndarray          # full row buffer [bucket] incl. prompt
+    prompt_len: int
+    length: int                 # prompt_len + generated count
+    bucket: int
+    finish_reason: str          # "eos" | "length"
+    submit_s: float = 0.0
+    first_token_s: float = 0.0  # submit -> first decoded token
+    done_s: float = 0.0         # submit -> completion
+
+    @property
+    def generated(self) -> np.ndarray:
+        """The generated region (includes the EOS token when one was
+        produced, mirroring ``greedy_generate_cached``'s buffer)."""
+        return self.tokens[self.prompt_len:self.length]
+
+
+# ---------------------------------------------------------------------------
+# the pure step bodies (named so shardlint treats them as traced code)
+# ---------------------------------------------------------------------------
+
+def init_serve_state(cfg: ModelConfig, batch: int, width: int
+                     ) -> Dict[str, Any]:
+    """Zeroed per-bucket batch state: token buffer, per-slot cursors and
+    the pooled KV cache. ``active`` starts all-False — empty slots run
+    the decode step as masked no-ops until admission fills them."""
+    return {
+        "buf": jnp.zeros((batch, width), jnp.int32),
+        "lens": jnp.zeros((batch,), jnp.int32),
+        "stop": jnp.zeros((batch,), jnp.int32),
+        "active": jnp.zeros((batch,), bool),
+        "cur": jnp.zeros((batch,), jnp.int32),
+        "cache": init_cache(cfg, batch, width),
+    }
+
+
+def make_prefill_fn(cfg: ModelConfig, *, lora_scale: float = 1.0
+                    ) -> Callable:
+    """``prefill_step(params, prompt[1, L], prompt_len[1], lora) ->
+    (first_tok[1], cache_row)`` — full-bucket-width prefill with lens=0:
+    garbage K/V past the prompt sit at positions strictly above every
+    query's until decode overwrites them (the kvcache.py invariant)."""
+    def prefill_step(params, prompt, prompt_len, lora):
+        B, L = prompt.shape
+        cache = init_cache(cfg, B, L)
+        logits, cache = forward_step(
+            params, prompt, cfg, cache, jnp.zeros((B,), jnp.int32),
+            lora=lora, lora_scale=lora_scale)
+        idx = jnp.clip(prompt_len - 1, 0, L - 1)
+        first = jnp.argmax(
+            jnp.take_along_axis(logits, idx[:, None, None],
+                                axis=1)[:, 0, :],
+            axis=-1).astype(jnp.int32)
+        return first, cache
+    return prefill_step
+
+
+def make_decode_fn(cfg: ModelConfig, eos_ids: Sequence[int], *,
+                   lora_scale: float = 1.0) -> Callable:
+    """``decode_step(params, state, lora) -> state`` — one iteration for
+    the whole slot batch. The per-slot update rule is EXACTLY
+    ``greedy_generate_cached``'s loop body (write the pending token,
+    forward one position, argmax, advance), with the loop-count bound
+    expressed as the per-slot absolute ``stop`` position — so a slot's
+    token stream is bit-identical to a batch-1 greedy decode."""
+    eos_host = np.asarray(list(eos_ids) or [-1], np.int32)
+
+    def decode_step(params, state, lora):
+        buf, lens, stop = state["buf"], state["lens"], state["stop"]
+        active, cur, cache = state["active"], state["cur"], state["cache"]
+        L = buf.shape[1]
+        eos = jnp.asarray(eos_host)
+        write_pos = jnp.clip(lens, 0, L - 1)
+        buf = jnp.where(
+            active[:, None] & (jnp.arange(L)[None, :] ==
+                               write_pos[:, None]),
+            cur[:, None], buf)
+        logits, cache = forward_step(
+            params, cur[:, None], cfg, cache, lens,
+            lora=lora, lora_scale=lora_scale)
+        next_tok = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+        now_eos = jnp.any(cur[:, None] == eos[None, :], axis=-1)
+        new_lens = jnp.where(~active | (lens >= L), lens, lens + 1)
+        new_active = active & ~now_eos & (new_lens < stop)
+        return {"buf": buf, "lens": new_lens, "stop": stop,
+                "active": new_active, "cur": next_tok, "cache": cache}
+    return decode_step
+
+
+def make_insert_fn() -> Callable:
+    """``insert_slot(state, slot, cache_row, prompt_row, prompt_len,
+    stop, first_tok) -> state`` — admit one prefilled request into slot
+    ``slot`` (a traced scalar: one compile serves every slot)."""
+    def insert_slot(state, slot, cache_row, prompt_row, prompt_len,
+                    stop, first_tok):
+        new_state = dict(state)
+        new_state["cache"] = insert_cache_slot(state["cache"], slot,
+                                               cache_row)
+        new_state["buf"] = jax.lax.dynamic_update_slice_in_dim(
+            state["buf"], prompt_row, slot, axis=0)
+        new_state["lens"] = state["lens"].at[slot].set(prompt_len[0])
+        new_state["stop"] = state["stop"].at[slot].set(stop[0])
+        new_state["active"] = state["active"].at[slot].set(True)
+        new_state["cur"] = state["cur"].at[slot].set(first_tok[0])
+        return new_state
+    return insert_slot
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Slot:
+    rid: str
+    prompt_len: int
+    submit_t: float
+    first_token_t: float
+
+
+class _BucketRuntime:
+    """Per-bucket state + slot bookkeeping (the host-side half; every
+    device-side transition happens in the three compiled steps)."""
+
+    def __init__(self, width: int, max_batch: int):
+        self.width = width
+        self.max_batch = max_batch
+        self.state: Optional[Dict[str, Any]] = None   # device pytree
+        self.slots: List[Optional[_Slot]] = [None] * max_batch
+        self.host_active = np.zeros((max_batch,), bool)
+        self.decodes = 0            # decode iterations run so far
+
+    def free_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def occupied(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+
+class BatchEngine:
+    """The in-process continuous-batching engine (the CPU-mesh tests
+    and ``BENCH_MODE=serve`` drive this directly; rayint/serving.py
+    wraps it in a Ray actor).
+
+    ``params`` may be a plain or quantized tree, optionally mesh-placed;
+    ``plan.serve_quant`` quantizes at construction when asked. All
+    executables build eagerly on first use of a bucket through
+    ``compile_step_with_plan`` — with ``sidecar_dir`` set they
+    AOT-serialize there, and a fresh engine pointed at the same dir
+    deserializes instead of compiling (cold-start-in-seconds path).
+    """
+
+    def __init__(self, params: Any, cfg: ModelConfig, *,
+                 plan: Optional[ExecutionPlan] = None,
+                 eos_ids: Sequence[int] = (),
+                 lora: Optional[Any] = None, lora_scale: float = 1.0,
+                 sidecar_dir: Optional[str] = None,
+                 heartbeat_fn: Optional[Callable[[int], None]] = None):
+        self.plan = plan if plan is not None else serve_plan()
+        self.cfg = cfg
+        self.params = quantize_for_serving(params, self.plan.serve_quant)
+        self.lora = lora
+        self.eos_ids = tuple(int(e) for e in eos_ids)
+        self.max_batch = self.plan.max_batch
+        self.buckets = [b for b in self.plan.bucket_list()
+                        if b <= cfg.max_seq_len]
+        if not self.buckets:
+            raise ValueError(
+                f"no declared bucket {self.plan.bucket_list()} fits "
+                f"max_seq_len={cfg.max_seq_len}")
+        self.sidecar_dir = sidecar_dir
+        self._heartbeat = heartbeat_fn
+        self._prefill_fn = make_prefill_fn(cfg, lora_scale=lora_scale)
+        self._decode_fn = make_decode_fn(cfg, self.eos_ids,
+                                         lora_scale=lora_scale)
+        self._insert_fn = make_insert_fn()
+        self._compiled: Dict[Tuple[str, int], Callable] = {}
+        self._runtimes: Dict[int, _BucketRuntime] = {}
+        self._pending: List[Request] = []
+        self._pending_bucket: Dict[str, int] = {}
+        self._completions: Dict[str, Completion] = {}
+        self._submit_t: Dict[str, float] = {}
+        self.iterations = 0
+        self.refills = 0            # admissions into a non-fresh batch
+        self.completed_total = 0    # process-lifetime completion count
+        # rolling windows (one entry per decode iteration): a replica
+        # serving for hours must not grow per iteration; p50/p99 and
+        # occupancy reflect the most recent traffic
+        from collections import deque
+        self._token_latencies: Any = deque(maxlen=10_000)
+        self._occupancy: Any = deque(maxlen=10_000)
+
+    # -- executables ---------------------------------------------------
+
+    def _sidecar(self, kind: str, width: int) -> Optional[str]:
+        if not self.sidecar_dir:
+            return None
+        return os.path.join(self.sidecar_dir,
+                            f"serve_{kind}_b{width}.bin")
+
+    def _abstract_lora(self):
+        from gke_ray_train_tpu.perf.cache import abstractify
+        return abstractify(self.lora) if self.lora is not None else None
+
+    def _get(self, kind: str, width: int) -> Callable:
+        key = (kind, width)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        from gke_ray_train_tpu.perf.cache import abstractify
+        aparams = abstractify(self.params)
+        alora = self._abstract_lora()
+        astate = jax.eval_shape(
+            partial(init_serve_state, self.cfg, self.max_batch, width))
+        if kind == "decode":
+            fn = compile_step_with_plan(
+                self.plan, None, self._decode_fn,
+                aparams, astate, alora,
+                donate_argnums=(1,), sidecar=self._sidecar(kind, width),
+                label=f"serve_decode_b{width}")
+        elif kind == "prefill":
+            aprompt = jax.ShapeDtypeStruct((1, width), jnp.int32)
+            alen = jax.ShapeDtypeStruct((1,), jnp.int32)
+            fn = compile_step_with_plan(
+                self.plan, None, self._prefill_fn,
+                aparams, aprompt, alen, alora,
+                donate_argnums=(), sidecar=self._sidecar(kind, width),
+                label=f"serve_prefill_b{width}")
+        else:  # insert
+            row_cache = jax.eval_shape(
+                partial(init_cache, self.cfg, 1, width))
+            scalars = jax.ShapeDtypeStruct((1,), jnp.int32)
+            fn = compile_step_with_plan(
+                self.plan, None, self._insert_fn,
+                astate, jax.ShapeDtypeStruct((), jnp.int32), row_cache,
+                jax.ShapeDtypeStruct((1, width), jnp.int32),
+                scalars, scalars, scalars,
+                # the batch-1 cache row is NOT donated: its [1, L] rows
+                # cannot alias into the pooled [B, L] buffer, and jax
+                # warns on every unusable donation
+                donate_argnums=(0,), sidecar=self._sidecar(kind, width),
+                label=f"serve_insert_b{width}")
+        self._compiled[key] = fn
+        return fn
+
+    def set_heartbeat(self, fn: Optional[Callable[[int], None]]) -> None:
+        """(Re)wire the per-iteration liveness beat — the deployment
+        (rayint/serving.py) points this at a Supervisor actor after the
+        engine is built, so a replica wedged mid-decode is detected by
+        the same board shape that watches training ranks."""
+        self._heartbeat = fn
+
+    def executable_info(self) -> Dict[str, Dict[str, Any]]:
+        """Build provenance per compiled executable ("deserialized" |
+        "compiled" | absent for plain-jit) — the warm-start tests'
+        witness that a fresh replica recompiled nothing."""
+        return {f"{k}_b{w}": dict(getattr(fn, "info", {}))
+                for (k, w), fn in self._compiled.items()}
+
+    def decode_cost_report(self, width: Optional[int] = None):
+        """StepCostReport of the decode executable (perf/costs.py) —
+        None when the executable cannot be introspected (plain jit or a
+        deserialized blob without analyses)."""
+        from gke_ray_train_tpu.perf.costs import step_cost_report
+        width = width or self.buckets[0]
+        fn = self._get("decode", width)
+        compiled = getattr(fn, "_compiled", None)
+        if compiled is None:
+            return None
+        try:
+            return step_cost_report(compiled,
+                                    tokens_per_step=self.max_batch)
+        except Exception as e:  # noqa: BLE001 - introspection best-effort
+            logger.debug("decode cost report unavailable: %s", e)
+            return None
+
+    def warm_up(self, widths: Optional[Sequence[int]] = None) -> None:
+        """Build (or deserialize) every executable for the given buckets
+        up front — the replica cold-start path, so the first request
+        pays dispatch latency, not compile latency."""
+        for w in widths or self.buckets:
+            for kind in ("prefill", "decode", "insert"):
+                self._get(kind, w)
+
+    # -- request intake ------------------------------------------------
+
+    def submit(self, request: Request) -> int:
+        """Queue a request; returns the bucket it will run in. Raises
+        ValueError when no declared bucket fits (reject up front — a
+        fixed-shape executable must never truncate silently)."""
+        # every per-request structure is keyed by rid alone: a
+        # duplicate (e.g. a client retry racing its original) would
+        # overwrite the first request's routing and double-pop its
+        # completion — reject it while the first is still in flight
+        # (_pending_bucket spans submit→retire) or unretrieved
+        if request.rid in self._pending_bucket \
+                or request.rid in self._completions:
+            raise ValueError(f"request {request.rid}: rid already in "
+                             "flight or unretrieved — rids must be "
+                             "unique per engine")
+        ids = np.asarray(request.token_ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError(f"request {request.rid}: empty prompt")
+        if request.max_new_tokens < 1:
+            raise ValueError(f"request {request.rid}: max_new_tokens="
+                             f"{request.max_new_tokens} must be >= 1")
+        # reject BEFORE truncating: even a 1-token prompt cannot fit —
+        # truncate_prompt would log a misleading head-DROPPED warning
+        # for a request that is rejected anyway
+        if request.max_new_tokens + 1 > self.buckets[-1]:
+            raise ValueError(
+                f"request {request.rid}: max_new_tokens="
+                f"{request.max_new_tokens} + a 1-token prompt needs "
+                f"{request.max_new_tokens + 1} slots but the largest "
+                f"usable bucket is {self.buckets[-1]} — lower "
+                "max_new_tokens or declare a larger bucket")
+        max_prompt = max(self.buckets[-1] - request.max_new_tokens, 1)
+        ids = truncate_prompt(ids, max_prompt,
+                              label=f"request {request.rid} prompt")
+        bucket = pick_bucket(len(ids), request.max_new_tokens,
+                             self.buckets, self.cfg.max_seq_len)
+        request = dataclasses.replace(request, token_ids=ids)
+        self._pending.append(request)
+        self._pending_bucket[request.rid] = bucket
+        self._submit_t[request.rid] = time.perf_counter()
+        return bucket
+
+    # -- the iteration loop --------------------------------------------
+
+    def _admit(self) -> None:
+        """Slot-level admission: fill every free slot whose bucket has a
+        pending request — prefill at batch 1, insert into the pool."""
+        still_pending: List[Request] = []
+        for req in self._pending:
+            width = self._pending_bucket[req.rid]
+            rt = self._runtimes.get(width)
+            if rt is None:
+                rt = self._runtimes[width] = _BucketRuntime(
+                    width, self.max_batch)
+            free = rt.free_slots()
+            if not free:
+                still_pending.append(req)
+                continue
+            slot = free[0]
+            if rt.state is None:
+                rt.state = init_serve_state(self.cfg, self.max_batch,
+                                            width)
+            elif rt.occupied() > 0 and rt.decodes > 0:
+                # a TRUE mid-batch refill: decode already ran for this
+                # batch and other sequences are live (the initial
+                # fill-up wave before the first decode is not a refill)
+                self.refills += 1
+            buf, plen = form_prompt_buffer(req.token_ids, width)
+            stop = min(plen + req.max_new_tokens, width)
+            first, cache_row = self._get("prefill", width)(
+                self.params, jnp.asarray(buf),
+                jnp.asarray([plen], jnp.int32), self.lora)
+            # the first decoded token exists only once prefill
+            # materializes — on an async backend stamping at dispatch
+            # would measure enqueue latency, not time-to-first-token
+            jax.block_until_ready(first)
+            rt.state = self._get("insert", width)(
+                rt.state, jnp.asarray(slot, jnp.int32), cache_row,
+                jnp.asarray(buf), jnp.asarray([plen], jnp.int32),
+                jnp.asarray([stop], jnp.int32), first)
+            now = time.perf_counter()
+            rt.slots[slot] = _Slot(req.rid, plen,
+                                   self._submit_t[req.rid], now)
+            rt.host_active[slot] = True
+        self._pending = still_pending
+
+    def _collect(self, rt: _BucketRuntime, active: np.ndarray,
+                 lens: np.ndarray, buf: Optional[np.ndarray]) -> None:
+        """Retire slots that went inactive this iteration."""
+        now = time.perf_counter()
+        for i, slot in enumerate(rt.slots):
+            if slot is None or active[i]:
+                continue
+            # np.array COPIES: device_get can return a zero-copy view of
+            # the device buffer (CPU backend), and the state is DONATED —
+            # without the copy, a later admit/decode reuses that buffer
+            # and the retired completion's tokens mutate under it
+            row = np.array(buf[i])
+            length = int(lens[i])
+            gen = row[slot.prompt_len:length]
+            reason = ("eos" if self.eos_ids and len(gen)
+                      and int(gen[-1]) in self.eos_ids else "length")
+            self._completions[slot.rid] = Completion(
+                rid=slot.rid, tokens=row, prompt_len=slot.prompt_len,
+                length=length, bucket=rt.width, finish_reason=reason,
+                submit_s=slot.submit_t,
+                first_token_s=slot.first_token_t - slot.submit_t,
+                done_s=now - slot.submit_t)
+            rt.slots[i] = None
+            rt.host_active[i] = False
+            self.completed_total += 1
+            # pre-completion bookkeeping dies with the request — a
+            # long-lived replica must not grow per served request
+            self._submit_t.pop(slot.rid, None)
+            self._pending_bucket.pop(slot.rid, None)
+
+    def step(self) -> int:
+        """One engine iteration: admit into free slots, then run ONE
+        decode step per live bucket. Returns the number of slots still
+        active across buckets (0 = drained)."""
+        self._admit()
+        total_active = 0
+        for rt in self._runtimes.values():
+            if rt.occupied() == 0:
+                continue
+            t0 = time.perf_counter()
+            rt.state = self._get("decode", rt.width)(
+                self.params, rt.state, self.lora)
+            rt.decodes += 1
+            # ONE batched fetch of the small control leaves per
+            # iteration (shardlint TPU001: never per-slot round-trips);
+            # buf rides along only when a slot may have finished
+            active, lens = jax.device_get(
+                (rt.state["active"], rt.state["lens"]))
+            dt = time.perf_counter() - t0
+            n_act = int(np.sum(rt.host_active))
+            self._token_latencies.append(dt)
+            self._occupancy.append(n_act / self.max_batch)
+            total_active += int(np.sum(active))
+            if bool(np.any(rt.host_active & ~active)):
+                buf = jax.device_get(rt.state["buf"])
+                self._collect(rt, active, lens, buf)
+        self.iterations += 1
+        if self._heartbeat is not None:
+            try:
+                self._heartbeat(self.iterations)
+            except Exception as e:  # noqa: BLE001 - liveness best-effort
+                logger.debug("serve heartbeat dropped: %s", e)
+        return total_active + len(self._pending)
+
+    def run_until_drained(self, requests: Sequence[Request] = ()
+                          ) -> List[Completion]:
+        """Submit ``requests`` and iterate until every queued request
+        completed; returns completions in submit order. Returned
+        completions are RELEASED from the engine (a long-lived replica
+        calls this per request batch and must not accumulate every
+        buffer it ever served) — use :meth:`completion` + manual
+        :meth:`step` when you need them retained."""
+        for r in requests:
+            self.submit(r)
+        want = [r.rid for r in requests]
+        while self.step() > 0:
+            pass
+        if want:
+            return [self._completions.pop(rid) for rid in want]
+        out = list(self._completions.values())
+        self._completions.clear()
+        return out
+
+    def completion(self, rid: str) -> Optional[Completion]:
+        return self._completions.get(rid)
+
+    # -- reporting -----------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Serving statistics: iteration count, batch occupancy and the
+        per-token latency distribution (one decode iteration produces
+        one token per active slot, so the iteration latency IS the
+        per-token latency)."""
+        lat = sorted(self._token_latencies)
+
+        def pct(p):
+            if not lat:
+                return 0.0
+            return lat[min(int(p / 100.0 * len(lat)), len(lat) - 1)]
+
+        return {
+            "iterations": self.iterations,
+            "refills": self.refills,
+            "completed": self.completed_total,
+            "pending": len(self._pending),
+            "batch_occupancy": (float(np.mean(self._occupancy))
+                                if self._occupancy else 0.0),
+            "p50_token_latency_s": pct(50),
+            "p99_token_latency_s": pct(99),
+            "plan_fingerprint": self.plan.fingerprint(),
+        }
+
+
+def post_train_smoke(params: Any, cfg: ModelConfig,
+                     plan: ExecutionPlan,
+                     prompt_ids: Sequence[np.ndarray], *,
+                     eos_ids: Sequence[int] = (),
+                     lora: Optional[Any] = None, lora_scale: float = 1.0,
+                     max_new_tokens: int = 32
+                     ) -> Optional[Tuple[List[Completion], Dict[str, Any]]]:
+    """The ``SERVE_AFTER_TRAIN`` hook both ray-jobs entries call after
+    training: run the given already-tokenized prompts through a fresh
+    continuous-batching engine on the just-trained weights (train →
+    serve on the same process, ROADMAP #2's loop closed end to end).
+    Returns (completions, stats), or None — with a loud warning — when
+    no declared bucket fits the model or no prompt is usable; a smoke
+    must degrade, not kill a finished training run."""
+    usable = [b for b in plan.bucket_list() if b <= cfg.max_seq_len]
+    if not usable:
+        logger.warning(
+            "SERVE_AFTER_TRAIN skipped: no declared bucket %s fits "
+            "max_seq_len=%d (set DECODE_BUCKETS)", plan.bucket_list(),
+            cfg.max_seq_len)
+        return None
+    prompts = [np.asarray(p, np.int32).reshape(-1) for p in prompt_ids]
+    prompts = [p for p in prompts if p.size]
+    if not prompts:
+        logger.warning("SERVE_AFTER_TRAIN skipped: no non-empty prompts")
+        return None
+    # one-shot in-process smoke: AOT off — the executables would build
+    # from abstract UNSHARDED args while the just-trained params are
+    # mesh-placed, so every AOT call would be rejected into the jit
+    # fallback anyway (a wasted build + a noisy guard log per bucket)
+    plan = dataclasses.replace(plan, aot_train_step=False)
+    # the budget must fit the declared buckets (a tight DECODE_BUCKETS
+    # would otherwise reject every request at submit) — a smoke clamps
+    # rather than crash
+    max_new_tokens = min(max_new_tokens, max(usable[-1] - 1, 1))
+    t0 = time.perf_counter()
+    try:
+        engine = BatchEngine(params, cfg, plan=plan, eos_ids=eos_ids,
+                             lora=lora, lora_scale=lora_scale)
+        comps = engine.run_until_drained([
+            Request(rid=f"smoke{i}", token_ids=p,
+                    max_new_tokens=max_new_tokens)
+            for i, p in enumerate(prompts)])
+    except Exception:  # noqa: BLE001 - the degrade contract below
+        # the whole point of this hook is "degrade, not kill": the
+        # training run already SUCCEEDED — a serving-smoke failure is
+        # loud telemetry, never a job failure
+        logger.warning("SERVE_AFTER_TRAIN failed; training output is "
+                       "unaffected", exc_info=True)
+        return None
+    stats = engine.stats()
+    stats["wall_s"] = round(time.perf_counter() - t0, 3)
+    stats["generated_tokens"] = int(
+        sum(c.length - c.prompt_len for c in comps))
+    logger.info(
+        "SERVE_AFTER_TRAIN: %d request(s) -> %d tokens in %.2fs "
+        "(occupancy %.2f, p50 %.1fms/token, plan %s)",
+        len(comps), stats["generated_tokens"], stats["wall_s"],
+        stats["batch_occupancy"], stats["p50_token_latency_s"] * 1e3,
+        stats["plan_fingerprint"])
+    return comps, stats

@@ -486,6 +486,60 @@ def train_loop_per_worker(config: dict):
             mesh=mesh, is_host0=ctx.is_host0(),
             tuned_lora=state.lora if use_lora else None,
             lora_scale=lora_cfg.scale if use_lora else 1.0)
+
+    # ---- optional post-train serving smoke (serve/, ROADMAP #2) ------
+    # train → serve in the same process: the comparison prompts run
+    # through the continuous-batching engine on the just-trained
+    # weights (LoRA runs serve base + adapters, never a merged tree).
+    # Single-host only: the engine's host-side scheduler is per-replica
+    # by design — a multi-host job serves via rayint/serving.py
+    # replicas instead.
+    # config-then-env (the README's "config and/or env" contract),
+    # str-parsed like SMOKE_TEST: the documented disable value "0"
+    # must actually disable (bool("0") is True)
+    serve_flag = config.get("SERVE_AFTER_TRAIN",
+                            os.environ.get("SERVE_AFTER_TRAIN", "0"))
+    if str(serve_flag).strip().lower() in ("1", "true"):
+        if n_hosts > 1:
+            logger.warning(
+                "SERVE_AFTER_TRAIN is single-host only (deploy "
+                "rayint/serving.py replicas for multi-host serving); "
+                "skipping")
+        else:
+            import numpy as np
+
+            from gke_ray_train_tpu.data.sft import render_chat
+            from gke_ray_train_tpu.serve import post_train_smoke
+            eos = ([int(tokenizer.eos_token_id)]
+                   if getattr(tokenizer, "eos_token_id", None) is not None
+                   else [])
+            prompts = []
+            for row in ds_test[:int(
+                    config.get("NUM_EVAL_SAMPLES_INFERENCE", 2))]:
+                msgs = format_gretel_sql_example(row)
+                text = render_chat(tokenizer, msgs,
+                                   add_generation_prompt=True)
+                prompts.append(np.asarray(
+                    tokenizer(text, add_special_tokens=False)["input_ids"],
+                    np.int32))
+            out = post_train_smoke(
+                state.params, cfg, plan, prompts, eos_ids=eos,
+                lora=state.lora if use_lora else None,
+                lora_scale=lora_cfg.scale if use_lora else 1.0,
+                max_new_tokens=64)
+            if out is not None and ctx.is_host0():
+                comps, stats = out
+                for c in comps:
+                    logger.info("serve smoke %s (%s): %s", c.rid,
+                                c.finish_reason,
+                                tokenizer.decode(c.generated))
+                # out_base may not exist yet (SAVE_STRATEGY=no and no
+                # AOT sidecar = nothing else created it); a smoke must
+                # not kill a finished training run
+                os.makedirs(out_base, exist_ok=True)
+                with open(os.path.join(out_base, "serve_smoke.json"),
+                          "w") as f:
+                    json.dump(stats, f, indent=2)
     return metrics
 
 

@@ -186,6 +186,29 @@ def train_loop_per_worker(config: dict):
             config, os.path.join(config.get("storage_path", "/tmp"),
                                  "profile")),
         is_host0=ctx.is_host0())
+
+    # ---- optional post-train serving smoke (serve/, ROADMAP #2) ------
+    # the just-pretrained LM serves a few continuations through the
+    # continuous-batching engine — train → serve on the same process.
+    # Single-host only (multi-host serves via rayint/serving.py).
+    serve_flag = config.get("SERVE_AFTER_TRAIN",
+                            os.environ.get("SERVE_AFTER_TRAIN", "0"))
+    if str(serve_flag).strip().lower() in ("1", "true"):
+        if n_hosts > 1:
+            logger.warning("SERVE_AFTER_TRAIN is single-host only; "
+                           "skipping")
+        else:
+            from gke_ray_train_tpu.serve import post_train_smoke
+            # a few sliding-window prefixes of the training corpus
+            prompts = [ids[i * 257:i * 257 + 48] for i in range(4)]
+            out = post_train_smoke(state.params, cfg, plan, prompts,
+                                   max_new_tokens=48)
+            if out is not None and ctx.is_host0():
+                comps, stats = out
+                for c in comps:
+                    logger.info("serve smoke %s: %r", c.rid,
+                                tok.decode(np.asarray(c.generated)))
+                ctx.report({**metrics, "serve_smoke": stats})
     return metrics
 
 

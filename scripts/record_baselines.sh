@@ -34,6 +34,11 @@ run moe            env BENCH_MODE=moe python bench.py
 run qwen2-lora     env BENCH_MODE=qwen2-lora python bench.py
 run decode         env BENCH_MODE=decode python bench.py
 
+# continuous-batching serving A/B (serve/engine.py): engine across
+# MAX_BATCH slots vs serial batch-1 greedy over the same request set,
+# + p50/p99 per-token latency, batch occupancy, decode StepCostReport
+run serve          env BENCH_MODE=serve python bench.py
+
 # fault-tolerance drill: time-to-recover (injected kill -> first
 # post-resume step) + checkpoint-save latency under SIGTERM (must fit
 # the preemption grace window); the record splits recompile time from

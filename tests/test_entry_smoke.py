@@ -48,14 +48,23 @@ def _base_config(tmp_path, **over):
 @pytest.mark.slow
 @pytest.mark.parametrize("over", [
     {"PACKING": True},
+    # the QLoRA branch also drills SERVE_AFTER_TRAIN: the quantized
+    # base + adapters serve through the continuous-batching engine
+    # right after training (train → serve in one process, serve/)
     {"GROUP_BY_LENGTH": True, "USE_QLORA": True, "LORA_R": 4,
-     "LORA_ALPHA": 8},
+     "LORA_ALPHA": 8, "SERVE_AFTER_TRAIN": True},
 ])
 def test_entry_branches_run_and_learn_shape(tmp_path, over,
                                             monkeypatch):
     monkeypatch.setenv("HF_HUB_OFFLINE", "1")
     mod = _entry_module()
     metrics = mod.train_loop_per_worker(_base_config(tmp_path, **over))
+    if over.get("SERVE_AFTER_TRAIN"):
+        smoke = os.path.join(str(tmp_path / "out"), "serve_smoke.json")
+        assert os.path.exists(smoke), "serve smoke did not write stats"
+        import json
+        stats = json.load(open(smoke))
+        assert stats["generated_tokens"] > 0 and stats["completed"] > 0
     assert metrics and "loss" in metrics, metrics
     assert metrics["loss"] > 0 and metrics["loss"] < 50
     assert "eval_loss" in metrics

@@ -1,0 +1,475 @@
+"""Continuous-batching serving engine (serve/) on the fake-8 CPU mesh.
+
+The load-bearing contract: iteration-level continuous batching must be
+BITWISE-identical to sequential ``greedy_generate_cached`` for the same
+request set — including after a mid-batch slot refill — because the
+engine's per-slot update rule IS the oracle's loop body. Plus: AOT
+decode-sidecar cold start with zero recompiles, quantized-weights
+serving, the Ray-actor replica path on the fake-ray harness, and the
+checked-in decode-step budget.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.models import (
+    greedy_generate_cached, init_params, tiny)
+from gke_ray_train_tpu.plan import ExecutionPlan
+from gke_ray_train_tpu.serve import (
+    BatchEngine, Request, form_prompt_buffer, pick_bucket,
+    post_train_smoke, prompt_bucket)
+
+EOS = 5
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny(vocab_size=97, d_model=32, n_layers=2, n_heads=4,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    return cfg, init_params(cfg, jax.random.key(0))
+
+
+def _plan(**kw):
+    base = dict(max_batch=3, decode_buckets="128", topology="cpu-8",
+                compile_cache=False, aot_train_step=False)
+    base.update(kw)
+    return ExecutionPlan.from_kwargs(**base)
+
+
+def _requests(cfg, spec, seed=1):
+    """spec = [(prompt_len, max_new), ...] → deterministic requests."""
+    rng = np.random.default_rng(seed)
+    return [Request(rid=f"r{i}",
+                    token_ids=rng.integers(1, cfg.vocab_size,
+                                           size=p).astype(np.int32),
+                    max_new_tokens=m)
+            for i, (p, m) in enumerate(spec)]
+
+
+def _oracle(params, cfg, req, bucket):
+    """Sequential batch-1 greedy decode — the bitwise reference."""
+    buf, plen = form_prompt_buffer(req.token_ids, bucket)
+    out = greedy_generate_cached(
+        params, jnp.asarray(buf), jnp.asarray([plen], jnp.int32), cfg,
+        max_new_tokens=req.max_new_tokens, eos_ids=(EOS,))
+    return np.asarray(out[0])
+
+
+# ---------------------------------------------------------------------------
+# sequential equivalence
+# ---------------------------------------------------------------------------
+
+def test_continuous_matches_sequential_bitwise(setup):
+    """Mixed-length request set, more requests than slots: every
+    completion's full buffer equals the batch-1 oracle's, bit for bit,
+    and finishing slots were refilled without flushing the batch."""
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
+    reqs = _requests(cfg, [(7, 12), (30, 20), (3, 8), (50, 16),
+                           (20, 24)])
+    comps = eng.run_until_drained(reqs)
+    assert [c.rid for c in comps] == [r.rid for r in reqs]
+    for r, c in zip(reqs, comps):
+        np.testing.assert_array_equal(c.tokens,
+                                      _oracle(params, cfg, r, 128))
+        assert c.prompt_len == len(r.token_ids)
+        assert 0 < c.length - c.prompt_len <= r.max_new_tokens
+    # 5 requests through 3 slots: at least two admissions landed in a
+    # live batch
+    assert eng.refills >= 2
+    stats = eng.stats()
+    assert stats["completed"] == 5 and stats["pending"] == 0
+    assert 0 < stats["batch_occupancy"] <= 1.0
+    assert stats["p99_token_latency_s"] >= stats["p50_token_latency_s"]
+    assert stats["plan_fingerprint"] == eng.plan.fingerprint()
+
+
+def test_eos_stops_a_slot(setup):
+    """A generated EOS retires the slot with finish_reason='eos' and
+    the oracle agrees on the full buffer."""
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                      eos_ids=(EOS,))
+    # long budgets: some sequence will hit EOS before the length stop
+    reqs = _requests(cfg, [(11, 60), (23, 60)], seed=3)
+    comps = eng.run_until_drained(reqs)
+    for r, c in zip(reqs, comps):
+        np.testing.assert_array_equal(c.tokens,
+                                      _oracle(params, cfg, r, 128))
+    reasons = {c.finish_reason for c in comps}
+    assert reasons <= {"eos", "length"}
+
+
+def test_mid_batch_refill_preserves_survivors(setup):
+    """The drilled admission contract: a request admitted into a slot
+    freed MID-DECODE must not perturb the surviving sequence — its
+    tokens stay bitwise-identical to a batch-1 run."""
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                      eos_ids=(EOS,))
+    short, long_ = _requests(cfg, [(6, 4), (40, 48)], seed=2)
+    eng.submit(short)
+    eng.submit(long_)
+    # decode until the short request retires while the long one is live
+    while eng.completion(short.rid) is None:
+        assert eng.step() > 0
+    assert eng.completion(long_.rid) is None, \
+        "test premise broken: long request finished with the short one"
+    refills_before = eng.refills
+    late = _requests(cfg, [(17, 10)], seed=9)[0]
+    late = dataclasses.replace(late, rid="late")
+    eng.submit(late)
+    while eng.step() > 0:
+        pass
+    assert eng.refills > refills_before     # admitted into a live batch
+    for req in (short, long_, late):
+        np.testing.assert_array_equal(
+            eng.completion(req.rid).tokens, _oracle(params, cfg, req, 128))
+
+
+def test_two_buckets_route_and_match(setup):
+    """Requests land in the smallest bucket that fits prompt+new and
+    each bucket's outputs match the oracle at that bucket's width."""
+    cfg, params = setup
+    cfg = dataclasses.replace(cfg, max_seq_len=256)
+    eng = BatchEngine(params, cfg, plan=_plan(decode_buckets="128,256"),
+                      eos_ids=(EOS,))
+    small, big = _requests(cfg, [(20, 16), (150, 24)], seed=4)
+    assert eng.submit(small) == 128
+    assert eng.submit(big) == 256
+    while eng.step() > 0:
+        pass
+    np.testing.assert_array_equal(eng.completion(small.rid).tokens,
+                                  _oracle(params, cfg, small, 128))
+    np.testing.assert_array_equal(eng.completion(big.rid).tokens,
+                                  _oracle(params, cfg, big, 256))
+
+
+# ---------------------------------------------------------------------------
+# admission contract
+# ---------------------------------------------------------------------------
+
+def test_unservable_request_rejected_up_front(setup):
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
+    with pytest.raises(ValueError, match="largest usable bucket"):
+        eng.submit(Request("big", np.arange(1, 10, dtype=np.int32),
+                           max_new_tokens=200))
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.submit(Request("empty", np.zeros((0,), np.int32), 8))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(Request("none", np.arange(1, 5, dtype=np.int32), 0))
+
+
+def test_overlong_prompt_truncates_loudly(setup, caplog):
+    """The reference silently kept the LAST max_prompt tokens; the
+    shared bucketing keeps the behavior but logs the drop."""
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
+    req = _requests(cfg, [(140, 16)], seed=6)[0]
+    with caplog.at_level("WARNING"):
+        assert eng.submit(req) == 128
+    assert any("DROPPED" in r.message for r in caplog.records)
+    while eng.step() > 0:
+        pass
+    trunc = dataclasses.replace(req, token_ids=req.token_ids[-112:])
+    np.testing.assert_array_equal(eng.completion(req.rid).tokens,
+                                  _oracle(params, cfg, trunc, 128))
+
+
+def test_generate_answer_warns_on_truncation(setup, caplog):
+    """inference.py's comparison path now shares serve/bucketing.py —
+    an over-long prompt is truncated with a warning, not silently."""
+    from gke_ray_train_tpu.data import ByteTokenizer
+    from gke_ray_train_tpu.inference import generate_answer
+    cfg, params = setup
+    with caplog.at_level("WARNING"):
+        out = generate_answer(params, cfg, ByteTokenizer(),
+                              "x" * (cfg.max_seq_len + 40),
+                              max_new_tokens=16)
+    assert isinstance(out, str)
+    assert any("DROPPED" in r.message for r in caplog.records)
+
+
+def test_bucketing_helpers():
+    assert prompt_bucket(1) == 128 and prompt_bucket(129) == 256
+    assert pick_bucket(10, 20, (128, 256)) == 128
+    assert pick_bucket(120, 20, (128, 256)) == 256
+    with pytest.raises(ValueError, match="largest usable bucket"):
+        pick_bucket(250, 20, (128, 256))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        pick_bucket(10, 10, (256,), max_seq_len=128)
+
+
+def test_generate_cache_is_bounded_and_clearable(dp_mesh):
+    """The replicated-generate cache must be explicitly releasable —
+    it is what used to pin torn-down meshes (and their buffers) for
+    the life of the process."""
+    from gke_ray_train_tpu import inference
+    inference.clear_generate_cache()
+    cfg = tiny(vocab_size=64, d_model=32, n_heads=2, n_kv_heads=2)
+    f1 = inference._replicated_generate(dp_mesh, cfg, 8, (), 1.0)
+    f2 = inference._replicated_generate(dp_mesh, cfg, 8, (), 1.0)
+    assert f1 is f2                          # cache hit, no rebuild
+    inference._replicated_generate(dp_mesh, cfg, 9, (), 1.0)
+    assert len(inference._GENERATE_CACHE) == 2
+    assert inference.clear_generate_cache() == 2
+    assert not inference._GENERATE_CACHE
+
+
+# ---------------------------------------------------------------------------
+# AOT sidecars: replica cold start without recompiling
+# ---------------------------------------------------------------------------
+
+def test_aot_sidecar_cold_start_zero_recompiles(setup, tmp_path):
+    """A fresh engine pointed at a warm sidecar dir deserializes every
+    executable ('deserialized' provenance, no backend compile of any
+    step fn) and produces bitwise-identical tokens — the replica
+    cold-start-in-seconds path (same drill as test_perf's train-step
+    sidecar)."""
+    from gke_ray_train_tpu.analysis.jaxprcheck import RecompileDetector
+    cfg, params = setup
+    plan = _plan(max_batch=2, aot_train_step=True)
+    reqs = _requests(cfg, [(9, 10), (21, 14), (5, 6)], seed=7)
+
+    eng1 = BatchEngine(params, cfg, plan=plan, eos_ids=(EOS,),
+                       sidecar_dir=str(tmp_path))
+    eng1.warm_up()
+    info1 = eng1.executable_info()
+    assert {v["source"] for v in info1.values()} == {"compiled"}
+    assert len(info1) == 3                   # prefill + decode + insert
+    comps1 = eng1.run_until_drained(reqs)
+
+    eng2 = BatchEngine(params, cfg, plan=plan, eos_ids=(EOS,),
+                       sidecar_dir=str(tmp_path))
+    with RecompileDetector() as det:
+        eng2.warm_up()
+        comps2 = eng2.run_until_drained([
+            dataclasses.replace(r) for r in reqs])
+    info2 = eng2.executable_info()
+    assert {v["source"] for v in info2.values()} == {"deserialized"}
+    assert not det.compiles, (
+        f"warm replica start must not compile any step fn; "
+        f"compiled: {sorted(det.compiles)}")
+    for a, b in zip(comps1, comps2):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+    # the decode cost surface stays introspectable for the AOT build
+    assert eng1.decode_cost_report() is not None
+
+
+def test_plan_change_invalidates_serve_sidecar(setup, tmp_path):
+    """A sidecar recorded under a different serve shape is stale by
+    construction (the AOT key embeds plan.compile_fingerprint())."""
+    cfg, params = setup
+    e1 = BatchEngine(params, cfg, plan=_plan(aot_train_step=True),
+                     eos_ids=(EOS,), sidecar_dir=str(tmp_path))
+    e1.warm_up()
+    plan2 = _plan(max_batch=2, aot_train_step=True)  # different shape
+    e2 = BatchEngine(params, cfg, plan=plan2, eos_ids=(EOS,),
+                     sidecar_dir=str(tmp_path))
+    e2.warm_up()
+    assert {v["source"] for v in e2.executable_info().values()} \
+        == {"compiled"}
+
+
+# ---------------------------------------------------------------------------
+# quantized serving
+# ---------------------------------------------------------------------------
+
+def test_quantized_weights_serving_matches_quantized_oracle(setup):
+    """serve_quant=int8 quantizes at engine construction; outputs are
+    bitwise-identical to the sequential oracle run on the SAME
+    quantized tree (quantization changes the model, not the engine)."""
+    from gke_ray_train_tpu.ops.quant import quantize_for_serving
+    cfg, params = setup
+    eng = BatchEngine(params, cfg, plan=_plan(serve_quant="int8"),
+                      eos_ids=(EOS,))
+    qparams = quantize_for_serving(params, "int8")
+    reqs = _requests(cfg, [(12, 10), (33, 12)], seed=8)
+    comps = eng.run_until_drained(reqs)
+    for r, c in zip(reqs, comps):
+        np.testing.assert_array_equal(c.tokens,
+                                      _oracle(qparams, cfg, r, 128))
+
+
+def test_quantize_for_serving_contract(setup):
+    from gke_ray_train_tpu.ops.quant import quantize_for_serving
+    cfg, params = setup
+    assert quantize_for_serving(params, "none") is params
+    assert quantize_for_serving(params, None) is params
+    with pytest.raises(ValueError, match="serve quant kind"):
+        quantize_for_serving(params, "fp4")
+
+
+# ---------------------------------------------------------------------------
+# plan surface
+# ---------------------------------------------------------------------------
+
+def test_serve_plan_fields_round_trip_dialects():
+    cfg_plan = ExecutionPlan.from_config(
+        {"MAX_BATCH": "16", "DECODE_BUCKETS": "512,256",
+         "SERVE_QUANT": "INT8"})
+    kw_plan = ExecutionPlan.from_kwargs(
+        max_batch=16, decode_buckets=[256, 512], serve_quant="int8")
+    assert cfg_plan.bucket_list() == (256, 512)
+    assert cfg_plan.fingerprint() == kw_plan.fingerprint()
+    with pytest.raises(Exception, match="serve_quant"):
+        ExecutionPlan.from_kwargs(serve_quant="fp4")
+    with pytest.raises(Exception, match="decode_buckets"):
+        ExecutionPlan.from_kwargs(decode_buckets="abc")
+    with pytest.raises(Exception, match="max_batch"):
+        ExecutionPlan.from_kwargs(max_batch=0)
+
+
+def test_serve_shape_splits_compile_fingerprint():
+    a = ExecutionPlan.from_kwargs()
+    b = ExecutionPlan.from_kwargs(max_batch=16)
+    c = ExecutionPlan.from_kwargs(prefetch=7)   # operational knob
+    assert a.compile_fingerprint() != b.compile_fingerprint()
+    assert a.compile_fingerprint() == c.compile_fingerprint()
+
+
+def test_post_train_smoke_runs_and_degrades(setup, caplog):
+    cfg, params = setup
+    out = post_train_smoke(
+        params, cfg, _plan(),
+        [np.arange(1, 20, dtype=np.int32),
+         np.arange(1, 9, dtype=np.int32)],
+        eos_ids=(EOS,), max_new_tokens=8)
+    assert out is not None
+    comps, stats = out
+    assert len(comps) == 2 and stats["generated_tokens"] > 0
+    # no declared bucket fits → loud skip, not a crash
+    with caplog.at_level("WARNING"):
+        assert post_train_smoke(params, cfg,
+                                _plan(decode_buckets="4096"),
+                                [np.arange(1, 9, dtype=np.int32)]) is None
+    assert any("SERVE_AFTER_TRAIN skipped" in r.message
+               for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# decode-step budget (tests/budgets/serve_tiny8.json)
+# ---------------------------------------------------------------------------
+
+def test_serve_decode_budget_checked_in():
+    """The serving decode step must sit within its checked-in budget
+    (any collective in the mesh-local decode = reshard bug; temp/flops
+    drift = a cache or attention regression). BUDGET_UPDATE=1
+    re-baselines — review the JSON diff like code."""
+    from gke_ray_train_tpu.perf.budget import (
+        SERVE_PRESETS, assert_within_budget, budget_path,
+        build_preset_report, plan_for_preset, write_budget)
+    for name in SERVE_PRESETS:
+        rep = build_preset_report(name)
+        path = budget_path(name)
+        if os.environ.get("BUDGET_UPDATE") == "1":
+            write_budget(rep, path, preset=name)
+            continue
+        assert os.path.exists(path), (
+            f"missing budget {path}; record it: python -m "
+            "gke_ray_train_tpu.perf.budget record")
+        assert_within_budget(rep, path, plan=plan_for_preset(name))
+        assert sum(rep.collective_counts.values()) == 0
+
+
+def test_serve_preset_plan_is_pinned_consistently():
+    """One fingerprint across the budget JSON, plan_for_preset and
+    plancheck's PLAN004 sweep (a stale serve budget fails lint)."""
+    from gke_ray_train_tpu.analysis.plancheck import repo_budget_findings
+    from gke_ray_train_tpu.perf.budget import (
+        budget_path, load_budget, plan_for_preset)
+    doc = load_budget(budget_path("serve_tiny8"))
+    assert doc["_plan_fingerprint"] == \
+        plan_for_preset("serve_tiny8").fingerprint()
+    assert not [f for f in repo_budget_findings()
+                if f.field == "serve_tiny8"]
+
+
+# ---------------------------------------------------------------------------
+# Ray-actor replica deployment (fake-ray harness)
+# ---------------------------------------------------------------------------
+
+def _factory(cfg, params, plan):
+    def build():
+        return BatchEngine(params, cfg, plan=plan, eos_ids=(EOS,))
+    return build
+
+
+def _payload(reqs):
+    return [{"rid": r.rid, "token_ids": r.token_ids.tolist(),
+             "max_new_tokens": r.max_new_tokens} for r in reqs]
+
+
+@pytest.fixture
+def fake_ray_serving(monkeypatch):
+    import sys
+
+    from test_rayint_cluster import make_fake_ray
+
+    import gke_ray_train_tpu.rayint.serving as serving_mod
+    record = {"actor_opts": [], "placement_groups": [], "actors": [],
+              "sched_bundles": [], "removed_pgs": [], "killed": []}
+    ray, mods = make_fake_ray(record)
+    monkeypatch.setattr(serving_mod, "ray", ray)
+    monkeypatch.setattr(serving_mod, "_HAS_RAY", True)
+    for name, mod in mods.items():
+        monkeypatch.setitem(sys.modules, name, mod)
+    monkeypatch.setitem(sys.modules, "ray", ray)
+    return record
+
+
+def test_ray_replica_deployment_smoke(setup, fake_ray_serving):
+    """The actor path end to end on the fake-ray harness: replicas
+    built as actors, requests scattered round-robin, completions
+    bitwise-equal to the oracle, heartbeats flowing to the Supervisor
+    actor, teardown kills every replica."""
+    from gke_ray_train_tpu.rayint.serving import ServeDeployment
+    from gke_ray_train_tpu.rayint.supervisor import Supervisor
+    cfg, params = setup
+    dep = ServeDeployment(_factory(cfg, params, _plan(max_batch=2)),
+                          num_replicas=2, use_ray=True)
+    infos = dep.start()
+    assert len(infos) == 2
+    reqs = _requests(cfg, [(10, 8), (25, 10), (6, 6)], seed=11)
+    payloads = dep.serve(_payload(reqs))
+    assert [p["rid"] for p in payloads] == [r.rid for r in reqs]
+    for r, p in zip(reqs, payloads):
+        np.testing.assert_array_equal(np.asarray(p["tokens"], np.int32),
+                                      _oracle(params, cfg, r, 128))
+        assert p["finish_reason"] in ("eos", "length")
+    # health: every replica beat the supervisor board; nothing stalled
+    sups = [a for a in fake_ray_serving["actors"]
+            if isinstance(a, Supervisor)]
+    assert len(sups) == 1
+    snap = sups[0].snapshot()
+    assert set(snap) == {0, 1} and all(v["step"] > 0
+                                       for v in snap.values())
+    assert dep.stalled(1e6) == []
+    stats = dep.stats()
+    assert len(stats) == 2 and all(s["completed"] >= 1 for s in stats)
+    dep.shutdown()
+    assert len(fake_ray_serving["killed"]) == 3   # 2 replicas + supervisor
+
+
+def test_local_deployment_path(setup):
+    """use_ray=False degrades to in-process replicas on a
+    HeartbeatBoard — the no-cluster path."""
+    from gke_ray_train_tpu.rayint.serving import ServeDeployment
+    cfg, params = setup
+    dep = ServeDeployment(_factory(cfg, params, _plan(max_batch=2)),
+                          num_replicas=2, use_ray=False)
+    dep.start()
+    reqs = _requests(cfg, [(8, 6), (19, 8)], seed=12)
+    payloads = dep.serve(_payload(reqs))
+    for r, p in zip(reqs, payloads):
+        np.testing.assert_array_equal(np.asarray(p["tokens"], np.int32),
+                                      _oracle(params, cfg, r, 128))
+    assert dep.stalled(1e6) == []
+    dep.shutdown()
