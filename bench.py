@@ -31,6 +31,11 @@ deliberately slow host stall, prefetch on vs off on one JSON line),
 ``recovery`` (fault drill: time-to-recover from an injected kill +
 checkpoint-save latency under SIGTERM, testing/faults.py; the record
 separates recompile time from restore+fast-forward time),
+``elastic`` (elastic-training drill on the canonical 8-fake-device CPU
+mesh: injected pool shrink 8→4→8, mesh re-formed + checkpoint resumed
+RESHARDED each time; value = goodput fraction from the per-attempt
+goodput ledger, plus time-to-first-step-after-shrink and the per-attempt
+shrink/grow event classification),
 ``compile`` (compile-once layer A/B, perf/: cold build vs warm
 persistent-cache build vs deserialized AOT executable, plus the
 compile-level StepCostReport — meaningful on ANY backend, including
@@ -768,6 +773,139 @@ def bench_recovery():
         compare_baseline=False)
 
 
+def bench_elastic():
+    """BENCH_MODE=elastic: the elastic-training drill (ROADMAP #1/#4)
+    on the canonical 8-fake-device CPU mesh — an injected pool shrink
+    8→4 at step k resumes RESHARDED on the 4-device survivors without
+    human intervention, and a grow event recovers to the full 8 on the
+    next attempt. One JSON line carries the two headline numbers:
+    value = the run's goodput fraction (step time / total wall-clock,
+    summed over attempts from the per-attempt goodput ledger), plus
+    time-to-first-step-after-shrink (restore + fast-forward + compile
+    of the attempt that re-formed the mesh — what an eviction actually
+    costs). The record pins the full ledger, the per-attempt event
+    classification (shrink/grow as preemptions, max_failures budget
+    untouched) and each attempt's plan fingerprint."""
+    import shutil
+    import tempfile
+
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) != 8:
+        # the drill is only meaningful on the canonical mesh (same
+        # policy as the budget CLI): re-exec onto 8 fake CPU devices
+        import subprocess
+
+        from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+        env = cpu_mesh_env(BENCH_MODE="elastic")
+        env.pop("GRAFT_FORCE_PROBE", None)
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
+
+    import numpy as np
+
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.rayint import (
+        FailureConfig, JaxTrainer, RunConfig)
+    from gke_ray_train_tpu.rayint.elastic import maybe_replan
+    from gke_ray_train_tpu.testing.faults import (
+        FaultInjector, parse_fault_spec, reset_fired, reset_pool)
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+
+    cfg = tiny(vocab_size=256, d_model=64, n_layers=2, n_heads=2,
+               n_kv_heads=2, d_ff=128, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    steps, shrink_step, grow_step, ckpt_every = 12, 5, 8, 2
+    B, S = 8, 32          # global batch: divisible by both pool sizes
+
+    def batches(epoch):
+        for i in range(steps):
+            rng = np.random.default_rng(epoch * 1000 + i)
+            yield {
+                "inputs": rng.integers(
+                    0, cfg.vocab_size, (B, S)).astype(np.int32),
+                "targets": rng.integers(
+                    0, cfg.vocab_size, (B, S)).astype(np.int32),
+                "weights": np.ones((B, S), np.float32)}
+
+    work = tempfile.mkdtemp(prefix="bench_elastic_")
+    config = {"MESH_DATA": 1, "MESH_FSDP": -1,
+              "PER_DEVICE_TRAIN_BATCH_SIZE": 1, "MAX_SEQ_LENGTH": S,
+              "TOPOLOGY": "cpu-8", "ELASTIC": "1"}
+    mesh_used = []
+
+    def worker(c):
+        plan, devs = maybe_replan(ExecutionPlan.resolve(c), config=c)
+        mesh_used.append(len(devs))
+        mesh = plan.build_mesh(devs)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step_fn = make_train_step(cfg, opt, mesh=mesh, donate=False)
+        mgr = CheckpointManager(os.path.join(work, "ckpt"),
+                                max_to_keep=2, score_attribute=None,
+                                async_save=False)
+        inj = FaultInjector(parse_fault_spec(
+            f"rank=0:kind=pool_shrink:to=4:step={shrink_step};"
+            f"rank=0:kind=pool_shrink:to=8:step={grow_step}"),
+            rank=0, ckpt_manager=mgr)
+        try:
+            final, _m = run_training(
+                state, step_fn, batches, epochs=1, ckpt_manager=mgr,
+                ckpt_every=ckpt_every,
+                place_batch=make_place_batch(mesh), fault_injector=inj)
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step))}
+
+    reset_fired()
+    reset_pool()
+    try:
+        res = JaxTrainer(
+            worker, train_loop_config=config, use_ray=False,
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=0,
+                                             max_preemptions=4),
+                retry_backoff_s=0.0)).fit()
+    finally:
+        reset_pool()
+        shutil.rmtree(work, ignore_errors=True)
+    if res.error or res.metrics.get("final_step") != steps or \
+            mesh_used != [8, 4, 8]:
+        raise RuntimeError(
+            f"elastic drill did not converge: status={res.status} "
+            f"error={res.error} mesh_used={mesh_used} "
+            f"metrics={res.metrics}")
+    # the attempt AFTER the shrink re-formed the mesh: its restart cost
+    # (restore resharded + fast-forward + recompile on the new shape)
+    # is what a slice eviction actually costs before training resumes
+    g_after_shrink = res.attempt_log[1]["goodput"]
+    tfs = (g_after_shrink["restore_s"] + g_after_shrink["fast_forward_s"]
+           + g_after_shrink["compile_s"])
+    events = [{k: e.get(k) for k in ("status", "event", "pool",
+                                     "resumed_step", "plan_fingerprint")
+               if k in e} for e in res.attempt_log]
+    _emit(
+        f"elastic goodput, injected shrink 8->4->8 drill "
+        f"({cfg.d_model}d/{cfg.n_layers}L seq {S}, {steps} steps, "
+        f"shrink@{shrink_step} grow@{grow_step}, "
+        f"{devices[0].device_kind} x8)",
+        100.0 * res.goodput["goodput_frac"], "% of wall-clock",
+        {"time_to_first_step_after_shrink_s": round(tfs, 4),
+         "attempts": res.attempts, "preemptions": res.preemptions,
+         "mesh_devices_per_attempt": mesh_used,
+         "goodput": {k: round(float(v), 4)
+                     for k, v in res.goodput.items()},
+         "ledger_terms": list(LEDGER_TERMS),
+         "events": events},
+        compare_baseline=False)
+
+
 def bench_compile():
     """BENCH_MODE=compile: the compile-once layer's A/B (perf/cache.py),
     meaningful with NO accelerator attached. One JSON line carries:
@@ -1112,6 +1250,7 @@ def main():
      "input-bound": bench_input_bound,
      "recovery": bench_recovery,
      "compile": bench_compile,
+     "elastic": bench_elastic,
      "decode": bench_decode,
      "serve": bench_serve}[mode]()
 

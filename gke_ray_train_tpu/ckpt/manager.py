@@ -16,13 +16,28 @@ and restores it into the same shardings.
 
 from __future__ import annotations
 
+import json
 import logging
-from typing import Any, Optional
+import os
+from typing import Any, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 logger = logging.getLogger(__name__)
+
+TOPOLOGY_NOTE = "topology.json"
+
+
+def _tree_n_devices(tree: Any) -> Optional[int]:
+    """Device count of the mesh a concrete pytree lives on (None when
+    no leaf carries a sharding — e.g. an abstract template)."""
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        devs = getattr(sharding, "device_set", None)
+        if devs:
+            return len(devs)
+    return None
 
 
 class CheckpointRestoreError(RuntimeError):
@@ -48,6 +63,37 @@ class CheckpointManager:
         )
         self._mgr = ocp.CheckpointManager(directory, options=self._options)
         self.directory = directory
+        # (saved_n_devices, restored_n_devices) of the last restore
+        # that crossed topologies — the elastic-resume witness the
+        # trainer/tests read; None = same-topology (or unknown) restore
+        self.last_restore_resharded: Optional[Tuple[int, int]] = None
+
+    def _note_topology(self, step: int, state: Any) -> None:
+        """Record the saving mesh's device count beside the checkpoints
+        (best-effort, host 0) so a later restore can SAY it resharded —
+        the save-time topology is not recoverable from orbax metadata."""
+        n = _tree_n_devices(state)
+        if n is None:
+            return
+        try:
+            if jax.process_index() != 0:
+                return
+        except Exception:  # noqa: BLE001 - backend-free callers
+            pass
+        try:
+            with open(os.path.join(str(self.directory),
+                                   TOPOLOGY_NOTE), "w") as f:
+                json.dump({"step": int(step), "n_devices": int(n)}, f)
+        except OSError as e:  # pragma: no cover - note is best-effort
+            logger.debug("could not write topology note: %s", e)
+
+    def saved_topology(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(str(self.directory),
+                                   TOPOLOGY_NOTE)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
 
     def save(self, step: int, state: Any, metrics: Optional[dict] = None,
              force: bool = False) -> bool:
@@ -55,6 +101,7 @@ class CheckpointManager:
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
                                metrics=metrics, force=force)
         if saved:
+            self._note_topology(step, state)
             logger.info("checkpoint saved at step %d (metrics=%s)",
                         step, metrics)
         return saved
@@ -260,6 +307,22 @@ class CheckpointManager:
                     "quarantining it and resuming from step %d",
                     bad, type(bad_err).__name__, bad_err, step)
                 self._quarantine(bad)
+            # elastic-resume witness: a restore onto a different device
+            # count than the save is a reshard (shardings re-derived
+            # from the template) — say so, and leave the evidence for
+            # the trainer's attempt log
+            self.last_restore_resharded = None
+            note = self.saved_topology()
+            cur_n = _tree_n_devices(state_like)
+            if note and cur_n and int(note.get("n_devices", 0)) and \
+                    int(note["n_devices"]) != cur_n:
+                self.last_restore_resharded = (int(note["n_devices"]),
+                                               cur_n)
+                logger.warning(
+                    "elastic resume: checkpoint step %d was saved on %d "
+                    "devices; restored RESHARDED onto %d (shardings "
+                    "re-derived from the restore template)",
+                    step, int(note["n_devices"]), cur_n)
             logger.info("resuming from checkpoint step %d in %s", step,
                         self.directory)
             return out, step

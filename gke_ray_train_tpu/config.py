@@ -80,6 +80,11 @@ KNOWN_KEYS = frozenset({
     # post-train serving smoke (serve/engine.py): run the comparison
     # prompts through the continuous-batching engine after training
     "SERVE_AFTER_TRAIN",
+    # elastic training (rayint/elastic.py): opt into mesh re-formation
+    # on pool shrink/grow, and the smallest pool worth re-forming on.
+    # Trainer-scoped (like SERVE_AFTER_TRAIN), not plan-scoped: they
+    # change retry policy, never the compiled program.
+    "ELASTIC", "MIN_DEVICES",
     # TPU / model-numerics extensions (the plan owns the mesh keys)
     "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
     "SMOKE_TEST",

@@ -162,6 +162,36 @@ def build_mesh(config: MeshConfig | None = None,
     return Mesh(dev_array, MESH_AXES)
 
 
+def slice_assignments(devices: Sequence[Any],
+                      num_slices: Optional[int] = None) -> list:
+    """Slice identity per device — THE ``slice_index`` contract.
+
+    Real multi-slice TPU devices carry ``.slice_index``; fake/CPU
+    devices emulate the hybrid layout :func:`build_mesh` uses
+    (contiguous row-major blocks become slices), so device ``i`` of
+    ``n`` belongs to slice ``i // (n // num_slices)``. Everything that
+    needs slice identity — per-slice failure domains in
+    ``rayint/supervisor.py``, the ``slice_evict`` fault in
+    ``testing/faults.py``, the elastic pool emulation (evicting the
+    LAST slice = truncating the device list) — reads it through this
+    one function so the contract cannot fork.
+
+    ``num_slices`` defaults to ``$NUM_SLICES`` (1 when unset — a
+    single-slice pool is one failure domain).
+    """
+    devices = list(devices)
+    if devices and all(getattr(d, "slice_index", None) is not None
+                       for d in devices):
+        return [int(d.slice_index) for d in devices]
+    n = len(devices)
+    ns = int(num_slices if num_slices is not None
+             else os.environ.get("NUM_SLICES", "1"))
+    if ns <= 1 or n == 0 or n % ns:
+        return [0] * n
+    per_slice = n // ns
+    return [i // per_slice for i in range(n)]
+
+
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 

@@ -158,7 +158,8 @@ def topology_fingerprint() -> Tuple[str, Dict[str, Any]]:
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None,
-                            plan=None) -> Optional[str]:
+                            plan=None,
+                            surface: str = "train") -> Optional[str]:
     """Point JAX's persistent compilation cache at shared storage.
 
     Resolution: explicit arg → ``plan.compile_cache_dir`` →
@@ -192,7 +193,11 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
         or os.environ.get("COMPILE_CACHE_DIR", DEFAULT_CACHE_DIR)
     digest, facts = topology_fingerprint()
     if plan is not None:
-        digest = f"{digest}-{plan.compile_fingerprint()[:8]}"
+        # per-surface compile identity (plan.py): a serving replica's
+        # cache subdir is keyed on the serve fields, a trainer's on the
+        # train fields — retuning one surface's knobs never cold-starts
+        # the other's cache
+        digest = f"{digest}-{plan.compile_fingerprint(surface)[:8]}"
     resolved = None
     for candidate in (os.path.join(base, digest),
                       os.path.join(_LOCAL_FALLBACK, digest)):
@@ -283,17 +288,20 @@ def _leaf_signature(leaf: Any) -> tuple:
     return (shape, dtype, repr(spec) if spec is not None else None)
 
 
-def aot_signature(*args_trees: Any, plan=None) -> str:
+def aot_signature(*args_trees: Any, plan=None,
+                  surface: str = "train") -> str:
     """Digest of the abstract input signature (treedef + per-leaf
     shape/dtype/partition-spec) + topology fingerprint + (when given)
-    the ExecutionPlan's COMPILE fingerprint — the validity key of a
-    serialized executable. A sidecar whose key mismatches is stale
-    (different mesh, model size, batch layout, chip, or a plan that
-    compiles a different program) and is ignored rather than loaded;
-    operational plan knobs deliberately do NOT invalidate it."""
+    the ExecutionPlan's per-``surface`` COMPILE fingerprint — the
+    validity key of a serialized executable. A sidecar whose key
+    mismatches is stale (different mesh, model size, batch layout,
+    chip, or a plan that compiles a different program on THIS surface)
+    and is ignored rather than loaded; operational plan knobs — and
+    the other surface's fields — deliberately do NOT invalidate it."""
     leaves, treedef = jax.tree.flatten(args_trees)
     payload = (topology_fingerprint()[0],
-               plan.compile_fingerprint() if plan is not None else None,
+               plan.compile_fingerprint(surface)
+               if plan is not None else None,
                str(treedef),
                [_leaf_signature(x) for x in leaves])
     return hashlib.sha256(repr(payload).encode()).hexdigest()
@@ -397,7 +405,7 @@ class GuardedStep:
 def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
                        sidecar: Optional[str] = None,
                        label: str = "train_step",
-                       plan=None) -> GuardedStep:
+                       plan=None, surface: str = "train") -> GuardedStep:
     """AOT-build a jitted step (or deserialize its sidecar) and return a
     :class:`GuardedStep`.
 
@@ -411,7 +419,7 @@ def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
       lives on shared storage.
     """
     args = tuple(abstractify(a) for a in abstract_args)
-    key = aot_signature(*args, plan=plan)
+    key = aot_signature(*args, plan=plan, surface=surface)
     info: Dict[str, Any] = {"label": label, "sidecar": sidecar}
     if plan is not None:
         info["plan_fingerprint"] = plan.fingerprint()

@@ -57,6 +57,12 @@ CHIP_COUNTS: Dict[str, int] = {
     "v5p-8": 8, "v5p-16": 16, "v5p-32": 32, "v5p-64": 64, "v5p-128": 128,
 }
 
+# non-preset chip counts are still declarable as "<family>-<n>" — an
+# elastic replan onto a 12-chip survivor pool must be able to NAME its
+# topology even though no nodepool preset ships that shape
+TOPOLOGY_FAMILIES: Tuple[str, ...] = tuple(sorted(
+    {k.split("-", 1)[0] for k in CHIP_COUNTS}))
+
 _TRANSFER_GUARD_MODES = (None, "log", "disallow")
 
 
@@ -172,8 +178,14 @@ class ExecutionPlan:
             raise PlanError(f"serve_quant={self.serve_quant!r} not in "
                             f"{_serve_quant_kinds()}")
         if self.topology not in CHIP_COUNTS:
-            raise PlanError(f"topology={self.topology!r} unknown; "
-                            f"presets: {sorted(CHIP_COUNTS)}")
+            fam, _, count = self.topology.partition("-")
+            if fam not in TOPOLOGY_FAMILIES or not count.isdigit() \
+                    or int(count) < 1:
+                raise PlanError(
+                    f"topology={self.topology!r} unknown; presets: "
+                    f"{sorted(CHIP_COUNTS)} (or <family>-<chips> with "
+                    f"family in {TOPOLOGY_FAMILIES} — the elastic-replan "
+                    "dialect for non-preset survivor pools)")
 
     # ------------------------------------------------------------------
     # dialect constructors
@@ -251,22 +263,42 @@ class ExecutionPlan:
         return {f.name: getattr(self, f.name)
                 for f in dataclasses.fields(self)}
 
-    def fingerprint(self) -> str:
+    def fingerprint(self, surface: Optional[str] = None) -> str:
         """Stable 16-hex-char identity of the declared plan — every
-        field. Recorded in budget JSONs, BENCH records, attempt logs."""
+        field. Recorded in budget JSONs, BENCH records, attempt logs.
+
+        ``surface="train"|"serve"`` narrows the identity to that
+        surface's compile-relevant fields (delegates to
+        :meth:`compile_fingerprint`) — the per-surface identity AOT
+        sidecars key on, so serve-only knobs (``MAX_BATCH`` /
+        ``DECODE_BUCKETS`` / ``SERVE_QUANT``) never churn TRAIN
+        sidecars and vice versa."""
+        if surface is not None:
+            return self.compile_fingerprint(surface)
         return hashlib.sha256(
             json.dumps(self.canonical(), sort_keys=True).encode()
         ).hexdigest()[:16]
 
-    def compile_fingerprint(self) -> str:
-        """Identity of the COMPILED PROGRAM the plan implies: only the
-        fields that change what XLA builds (:data:`COMPILE_RELEVANT_
-        FIELDS`). This is what AOT sidecar keys and compile-cache
-        subdirs embed (composed with the runtime topology fingerprint,
-        which supplies device kind/count) — toggling an operational
-        knob (prefetch depth, a guard, the cache dir itself) must NOT
-        invalidate a bitwise-identical executable."""
-        payload = {f: getattr(self, f) for f in COMPILE_RELEVANT_FIELDS}
+    def compile_fingerprint(self, surface: str = "train") -> str:
+        """Identity of the COMPILED PROGRAM the plan implies for one
+        compile *surface*: the mesh fields plus that surface's own
+        program-shaping fields (:data:`COMPILE_SURFACES`). This is what
+        AOT sidecar keys and compile-cache subdirs embed (composed with
+        the runtime topology fingerprint, which supplies device
+        kind/count) — toggling an operational knob (prefetch depth, a
+        guard, the cache dir itself) must NOT invalidate a
+        bitwise-identical executable, and the OTHER surface's fields
+        must not either: retuning ``DECODE_BUCKETS`` on a serving
+        replica must not stale the training job's sidecar.
+        ``surface="all"`` hashes the union (the PLAN004 comparison
+        domain)."""
+        try:
+            fields = COMPILE_SURFACES[surface]
+        except KeyError:
+            raise PlanError(f"surface={surface!r} not in "
+                            f"{sorted(COMPILE_SURFACES)}") from None
+        payload: Dict[str, Any] = {"surface": surface}
+        payload.update({f: getattr(self, f) for f in fields})
         return hashlib.sha256(
             json.dumps(payload, sort_keys=True).encode()).hexdigest()[:16]
 
@@ -276,7 +308,10 @@ class ExecutionPlan:
 
     @property
     def chips(self) -> int:
-        return CHIP_COUNTS[self.topology]
+        if self.topology in CHIP_COUNTS:
+            return CHIP_COUNTS[self.topology]
+        # validated "<family>-<n>" non-preset shape (elastic replan)
+        return int(self.topology.split("-", 1)[1])
 
     def mesh_config(self) -> MeshConfig:
         return MeshConfig(data=self.data, fsdp=self.fsdp, model=self.model,
@@ -493,19 +528,126 @@ CONFIG_KEYS: Dict[str, str] = {
     "budget_preset": "BUDGET_PRESET",
 }
 
-# the fields that determine the COMPILED PROGRAM (mesh layout, batch
-# shape, donation, pipeline schedule). compile_fingerprint() hashes
-# exactly these; plancheck's PLAN004 budget-compatibility rule compares
-# exactly these — one list, no drift between the two.
-COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
-    "data", "fsdp", "model", "context", "pipe", "num_slices",
+# the fields that determine a COMPILED PROGRAM, split by compile
+# surface. The mesh fields shape every program; the train-only fields
+# shape the train/eval step; the serve-only fields shape the engine's
+# prefill/decode/insert executables. compile_fingerprint(surface)
+# hashes mesh + that surface's own fields, so a serve-knob retune
+# (MAX_BATCH, DECODE_BUCKETS, SERVE_QUANT) no longer stales TRAIN AOT
+# sidecars — the PR 7 tradeoff, removed. plancheck's PLAN004
+# budget-compatibility rule compares the union (COMPILE_RELEVANT_
+# FIELDS) — a budget pins one exact program on both surfaces.
+_MESH_COMPILE_FIELDS: Tuple[str, ...] = (
+    "data", "fsdp", "model", "context", "pipe", "num_slices")
+_TRAIN_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     "pipe_microbatches", "pipe_virtual_stages",
     "per_device_batch", "grad_accum", "max_seq_len", "packing",
-    "donate_state", "donate_batch",
-    # serving shape: slot count / bucket widths / weight encoding all
-    # change the prefill+decode programs the engine compiles, so they
-    # must invalidate serve sidecars and split the compile cache
+    "donate_state", "donate_batch")
+_SERVE_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     "max_batch", "decode_buckets", "serve_quant")
+COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
+    _MESH_COMPILE_FIELDS + _TRAIN_ONLY_COMPILE_FIELDS
+    + _SERVE_ONLY_COMPILE_FIELDS)
+COMPILE_SURFACES: Dict[str, Tuple[str, ...]] = {
+    "train": _MESH_COMPILE_FIELDS + _TRAIN_ONLY_COMPILE_FIELDS,
+    "serve": _MESH_COMPILE_FIELDS + _SERVE_ONLY_COMPILE_FIELDS,
+    "all": COMPILE_RELEVANT_FIELDS,
+}
+
+
+# ---------------------------------------------------------------------------
+# elastic replan: re-resolve a plan against a changed device pool
+# ---------------------------------------------------------------------------
+
+def replan(plan: ExecutionPlan, n_devices: int, *, model_cfg=None,
+           preserve_global_batch: bool = True) -> ExecutionPlan:
+    """The elastic-resume half of PLAN003's promise: given a plan and
+    the SURVIVING device count (a slice evicted, a spot pool shrunk, a
+    node returned), pick the largest feasible axis assignment on the
+    new pool.
+
+    Rules (the same reshard dialect plancheck's portability matrix
+    statically validates):
+
+    - the *structural* axes (model, context, pipe) are NEVER reflowed —
+      they change the compiled program and the logical layout; a pool
+      that cannot tile them is a :class:`PlanError` (a PLAN001-class
+      rejection, surfaced, not crashed);
+    - only data/fsdp reflow, preferring the assignment closest to the
+      declared data:fsdp ratio (ties: larger fsdp — params keep
+      sharding);
+    - ``num_slices`` shrinks proportionally when the eviction removed
+      whole slices, else collapses to 1;
+    - the global batch is preserved by default (``per_device_batch``
+      scales inversely with the data-parallel width when it divides
+      evenly) so the optimization trajectory survives the reshard;
+    - the declared topology is re-pinned to ``<family>-<n_devices>``
+      and a pinned ``budget_preset`` is dropped — the recorded budget
+      describes the OLD mesh's program and would trip PLAN004 as a
+      false drift signal;
+    - every candidate is validated (PLAN001 arithmetic, and PLAN002
+      model-dim divisibility when ``model_cfg`` is given); an
+      infeasible pool raises :class:`PlanError` carrying the findings.
+
+    ``replan(plan, plan.chips)`` is the identity — recovery to the
+    full shape is the same call, at the attempt where the pool grew
+    back.
+    """
+    import math
+
+    if n_devices < 1:
+        raise PlanError(f"replan: n_devices={n_devices} must be >= 1")
+    try:
+        base = plan.resolved_sizes()
+    except ValueError as e:
+        raise PlanError("replan: the declared plan does not tile its "
+                        f"own topology: {e}") from None
+    if n_devices == plan.chips:
+        return plan
+    structural = base["model"] * base["context"] * base["pipe"]
+    if n_devices % structural:
+        raise PlanError(
+            f"replan: {n_devices} surviving devices cannot tile the "
+            f"structural axes (model={base['model']} x "
+            f"context={base['context']} x pipe={base['pipe']} = "
+            f"{structural}); structural axes are never reflowed — only "
+            "data/fsdp")
+    remaining = n_devices // structural
+    global_rows = plan.per_device_batch * base["data"] * base["fsdp"]
+    ratio0 = math.log(base["data"] / base["fsdp"])
+    candidates = sorted(
+        ((d, remaining // d) for d in range(1, remaining + 1)
+         if remaining % d == 0),
+        key=lambda df: (abs(math.log(df[0] / df[1]) - ratio0), -df[1]))
+    # whole-slice evictions keep the DCN layout; anything else
+    # collapses to one slice (the data axis no longer tiles slices)
+    if plan.num_slices > 1 and \
+            (plan.num_slices * n_devices) % plan.chips == 0:
+        surviving_slices = max(plan.num_slices * n_devices
+                               // plan.chips, 1)
+    else:
+        surviving_slices = 1
+    family = plan.topology.split("-", 1)[0]
+    rejections: List[str] = []
+    for data, fsdp in candidates:
+        slices = surviving_slices if data % surviving_slices == 0 else 1
+        pdb = plan.per_device_batch
+        if preserve_global_batch and global_rows % (data * fsdp) == 0:
+            pdb = max(global_rows // (data * fsdp), 1)
+        cand = dataclasses.replace(
+            plan, data=data, fsdp=fsdp, num_slices=slices,
+            per_device_batch=pdb, topology=f"{family}-{n_devices}",
+            budget_preset=None)
+        findings = cand.feasibility(model_cfg)
+        if not findings:
+            return cand
+        rejections.extend(f"data={data} fsdp={fsdp}: {m}"
+                          for m in findings[:2])
+    raise PlanError(
+        f"replan: no feasible data/fsdp assignment on {n_devices} "
+        f"devices (structural axes model={base['model']} "
+        f"context={base['context']} pipe={base['pipe']} kept): "
+        + "; ".join(rejections[:6]))
 
 # plan knobs the trainer forwards from the driver env to Ray workers
 # (rayint/trainer.py) — derived from the mapping so a renamed knob
@@ -571,7 +713,8 @@ def compile_step_with_plan(plan: ExecutionPlan, mesh, fn: Callable,
                            out_shardings: Any = None,
                            donate_argnums: Optional[Tuple[int, ...]] = None,
                            sidecar: Optional[str] = None,
-                           label: str = "train_step") -> Callable:
+                           label: str = "train_step",
+                           surface: str = "train") -> Callable:
     """Compile a step function under one plan — the single surface
     training, bench, and analysis all route through.
 
@@ -583,9 +726,11 @@ def compile_step_with_plan(plan: ExecutionPlan, mesh, fn: Callable,
     built ahead of time via ``jit(...).lower(...).compile()`` (hitting
     the persistent cache when warm) and — when ``sidecar`` is set and
     ``plan.aot_train_step`` — serialized beside the checkpoint under a
-    key that embeds ``plan.compile_fingerprint()``, so a sidecar
+    key that embeds ``plan.compile_fingerprint(surface)``, so a sidecar
     recorded under a plan that compiles a DIFFERENT program is stale by
-    construction (operational knobs don't invalidate it).
+    construction (operational knobs don't invalidate it, and neither do
+    the OTHER surface's fields — serving knobs don't churn train
+    sidecars; the engine passes ``surface="serve"``).
     """
     import jax
 
@@ -627,4 +772,4 @@ def compile_step_with_plan(plan: ExecutionPlan, mesh, fn: Callable,
         return fn
     from gke_ray_train_tpu.perf.cache import build_or_load_step
     return build_or_load_step(fn, *abstract_args, sidecar=sidecar,
-                              label=label, plan=plan)
+                              label=label, plan=plan, surface=surface)

@@ -20,6 +20,14 @@ class TrainContext:
         # step the current attempt resumed from (None = fresh start);
         # set by the train loop, read into Result.attempt_log
         self.resumed_step: Optional[int] = None
+        # the attempt's goodput ledger (train/metrics.py LEDGER_TERMS),
+        # set by the loop's exit path on success AND failure — on the
+        # local trainer path it survives a crashed attempt where the
+        # worker payload does not
+        self.goodput: Optional[dict] = None
+        # fingerprint of the ExecutionPlan the attempt ran under (set
+        # by _run_worker after resolve/replan) — attempt_log provenance
+        self.plan_fingerprint: Optional[str] = None
         # heartbeat sink wired by the trainer: callable(rank, step, done)
         # forwarding to the supervisor actor (Ray) or the local board
         self._heartbeat = None
@@ -55,6 +63,9 @@ class TrainContext:
 
     def note_resume(self, step: Optional[int]) -> None:
         self.resumed_step = step
+
+    def note_goodput(self, ledger: Optional[dict]) -> None:
+        self.goodput = dict(ledger) if ledger is not None else None
 
     def report(self, metrics: dict, checkpoint_path: Optional[str] = None):
         """train.report parity: metrics become the trainer Result. Unlike

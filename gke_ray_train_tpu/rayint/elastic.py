@@ -1,0 +1,113 @@
+"""Elastic mesh re-formation — the worker-side half (ROADMAP #1/#4).
+
+The trainer's retry loop (``rayint/trainer.py``) classifies a pool
+change (slice eviction, spot shrink, node return) post-mortem and
+injects the surviving device count into the next attempt's worker env
+(``ELASTIC_N_DEVICES``). This module is what the worker/entry side
+does with it:
+
+- :func:`elastic_devices` — the devices this attempt may use. On real
+  hardware an evicted slice's devices simply are not in
+  ``jax.devices()``; on the fake/CPU drill the pool is emulated by
+  truncating the device list, which — per the ``slice_index`` contract
+  (``parallel/mesh.py::slice_assignments``, contiguous blocks) — is
+  exactly "the last slice(s) were evicted".
+- :func:`maybe_replan` — re-resolve the declared :class:`ExecutionPlan`
+  against the surviving pool via ``plan.replan`` (data/fsdp reflowed,
+  structural axes kept, global batch preserved, budget pin dropped) and
+  log the re-formation. A no-op when the pool matches the plan or
+  elasticity is off — a non-elastic job keeps today's behavior of
+  waiting for its original topology.
+
+Knobs (env and/or flat config, audited in ``config.py`` KNOWN_KEYS):
+
+- ``ELASTIC=1`` opts a job into mesh re-formation (default off).
+- ``MIN_DEVICES=N`` floors the pool the trainer will re-form on —
+  below it the run fails instead of limping (default 1).
+
+Stdlib-only until a device list is actually needed — importable by the
+driver-side trainer without jax.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# per-attempt worker env the trainer injects after a pool change
+POOL_ENV = "ELASTIC_N_DEVICES"
+
+
+def _knob(name: str, config=None) -> Optional[str]:
+    if config is not None and name in config:
+        return str(config[name])
+    return os.environ.get(name)
+
+
+def elastic_enabled(config=None) -> bool:
+    v = _knob("ELASTIC", config)
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def min_devices(config=None) -> int:
+    v = _knob("MIN_DEVICES", config)
+    try:
+        return max(int(v), 1) if v is not None else 1
+    except ValueError:
+        logger.warning("MIN_DEVICES=%r is not an int; using 1", v)
+        return 1
+
+
+def elastic_devices(devices=None) -> List[Any]:
+    """The device pool this attempt runs on: ``jax.devices()`` (or the
+    given list) truncated to ``$ELASTIC_N_DEVICES`` when the trainer
+    marked the pool shrunken. Truncation takes the FIRST n devices —
+    the emulated hybrid layout assigns slices to contiguous blocks, so
+    this is eviction of the last slice(s), matching what a real
+    eviction does to ``jax.devices()``."""
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    devices = list(devices)
+    raw = os.environ.get(POOL_ENV)
+    if not raw:
+        return devices
+    try:
+        n = int(raw)
+    except ValueError:
+        logger.warning("%s=%r is not an int; using the full pool",
+                       POOL_ENV, raw)
+        return devices
+    if 0 < n < len(devices):
+        return devices[:n]
+    return devices
+
+
+def maybe_replan(plan, devices=None, *, config=None, model_cfg=None,
+                 log: Optional[logging.Logger] = None
+                 ) -> Tuple[Any, List[Any]]:
+    """(plan, devices) for this attempt: the declared plan re-resolved
+    against the surviving pool when elasticity is on and the pool
+    changed. Raises ``PlanError`` when no feasible reflow exists (the
+    trainer fails fast with the findings — PLAN001/002 surfaced, not
+    crashed)."""
+    devs = elastic_devices(devices)
+    # re-form ONLY on a trainer-issued pool notice: a declared topology
+    # that simply differs from the host's device count (a deliberate
+    # subset/debug run) is not an elastic event and must not be
+    # silently replanned
+    if not os.environ.get(POOL_ENV) or len(devs) == plan.chips \
+            or not elastic_enabled(config):
+        return plan, devs
+    from gke_ray_train_tpu.plan import replan
+    new_plan = replan(plan, len(devs), model_cfg=model_cfg)
+    (log or logger).warning(
+        "elastic re-formation: pool %d -> %d devices; plan %s -> %s "
+        "(mesh %s, per_device_batch %d, topology %s)",
+        plan.chips, len(devs), plan.fingerprint(), new_plan.fingerprint(),
+        {a: getattr(new_plan, a) for a in new_plan.axis_names()},
+        new_plan.per_device_batch, new_plan.topology)
+    return new_plan, devs

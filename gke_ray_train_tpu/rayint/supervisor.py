@@ -28,7 +28,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
@@ -38,17 +38,52 @@ StallInfo = Tuple[int, int, float]
 
 class HeartbeatTimeout(RuntimeError):
     """An attempt killed because a named rank stopped making step
-    progress. Retryable: workers resume from the latest checkpoint."""
+    progress. Retryable: workers resume from the latest checkpoint.
 
-    def __init__(self, stalled: List[StallInfo], timeout_s: float):
+    ``slice_map`` (rank → slice index, per the ``slice_index`` contract
+    in ``parallel/mesh.py``) scopes the failure domain: when EVERY
+    stalled rank belongs to one slice (``uniform_slice``), the
+    signature is a slice eviction/loss — the trainer classifies it as a
+    *shrink* event (elastic re-form on the survivors) instead of a
+    whole-job failure burning ``max_failures``."""
+
+    def __init__(self, stalled: List[StallInfo], timeout_s: float,
+                 slice_map: Optional[Dict[int, int]] = None):
         self.stalled = list(stalled)
         self.timeout_s = timeout_s
+        self.slice_map = dict(slice_map or {})
         ranks = ", ".join(
-            f"rank {r} (last step {s}, {age:.1f}s ago)"
+            f"rank {r} (last step {s}, {age:.1f}s ago"
+            + (f", slice {self.slice_map[r]}" if r in self.slice_map
+               else "") + ")"
             for r, s, age in self.stalled)
-        super().__init__(
-            f"heartbeat timeout: no step progress for {timeout_s:g}s "
-            f"from {ranks}; killed all workers for retry-with-resume")
+        msg = (f"heartbeat timeout: no step progress for {timeout_s:g}s "
+               f"from {ranks}; killed all workers for retry-with-resume")
+        u = self.uniform_slice
+        if u is not None:
+            msg += (f" [every stalled rank is on slice {u} — "
+                    "slice-loss signature]")
+        super().__init__(msg)
+
+    @property
+    def uniform_slice(self) -> Optional[int]:
+        """The single slice every stalled rank belongs to, or None when
+        the stall spans slices (or no slice identity is known)."""
+        if not self.stalled or not self.slice_map:
+            return None
+        slices = {self.slice_map.get(r) for r, _, _ in self.stalled}
+        if len(slices) == 1 and None not in slices:
+            return slices.pop()
+        return None
+
+
+def slice_shrink_pool(evicted_slice: int, slice_map: Dict[int, int],
+                      chips_per_worker: float) -> int:
+    """Surviving chip count after one slice's workers are written off —
+    the pool the elastic trainer re-forms on when a stall has the
+    slice-loss signature (every rank of ``slice_map`` is a worker)."""
+    survivors = sum(1 for s in slice_map.values() if s != evicted_slice)
+    return int(survivors * chips_per_worker)
 
 
 class HeartbeatBoard:
@@ -62,6 +97,18 @@ class HeartbeatBoard:
         self._lock = threading.Lock()
         self._last = {}      # rank -> (step, monotonic_time)
         self._done = set()
+        self._slices = {}    # rank -> slice index (slice_index contract)
+
+    def set_slices(self, mapping: Dict[int, int]) -> None:
+        """Teach the board slice identity (rank → slice index) so stall
+        reports carry the failure domain, not just the rank."""
+        with self._lock:
+            self._slices.update({int(r): int(s)
+                                 for r, s in mapping.items()})
+
+    def slice_map(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._slices)
 
     def beat(self, rank: int, step: int, done: bool = False) -> None:
         now = time.monotonic()
@@ -85,7 +132,9 @@ class HeartbeatBoard:
     def snapshot(self) -> dict:
         with self._lock:
             return {rank: {"step": step, "age_s": time.monotonic() - t,
-                           "done": rank in self._done}
+                           "done": rank in self._done,
+                           **({"slice": self._slices[rank]}
+                              if rank in self._slices else {})}
                     for rank, (step, t) in self._last.items()}
 
 
@@ -99,6 +148,9 @@ class Supervisor:
 
     def beat(self, rank: int, step: int, done: bool = False) -> None:
         self._board.beat(rank, step, done=done)
+
+    def set_slices(self, mapping: Dict[int, int]) -> None:
+        self._board.set_slices(mapping)
 
     def stalled(self, timeout_s: float) -> List[StallInfo]:
         return self._board.stalled(timeout_s)

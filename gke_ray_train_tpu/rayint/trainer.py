@@ -118,6 +118,17 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = dataclasses.field(
         default_factory=FailureConfig)
+    # elastic mesh re-formation (rayint/elastic.py): when a preemption
+    # or failure's post-mortem shows the device pool changed (slice
+    # eviction, spot shrink, node return), the next attempt re-resolves
+    # the ExecutionPlan on the survivors (plan.replan), re-forms the
+    # mesh, and restores resharded — instead of burning the retry
+    # budget waiting for the old topology. None = $ELASTIC (default
+    # off: a non-elastic job keeps the wait-for-identical behavior).
+    elastic: Optional[bool] = None
+    # the smallest pool worth re-forming on; below it the run fails
+    # with a clear error instead of limping. None = $MIN_DEVICES or 1.
+    min_devices: Optional[int] = None
     # Hang detection (SURVEY.md §5.3): with no bound, one wedged worker
     # (deadlocked collective, dead TPU host) blocks ray.get forever and
     # FailureConfig never gets its chance. When set, an attempt that
@@ -149,8 +160,15 @@ class Result:
     attempts: int = 1
     preemptions: int = 0
     # one dict per attempt: {"status", "error"?, "step"?, "resumed_step"?,
-    # "ckpt_save_s"?, "nonretryable"?}
+    # "ckpt_save_s"?, "nonretryable"?, "goodput" (the per-attempt
+    # ledger, train/metrics.py LEDGER_TERMS + wall_s), "event"?
+    # ("shrink"|"grow" on elastic pool changes), "pool"? (surviving
+    # device count), "plan_fingerprint"?}
     attempt_log: list = dataclasses.field(default_factory=list)
+    # summed goodput ledger across every attempt: LEDGER_TERMS +
+    # "wall_s" + the headline "goodput_frac" (= step_s / wall_s) —
+    # terms reconcile to wall-clock by construction (tests assert it)
+    goodput: dict = dataclasses.field(default_factory=dict)
 
 
 def _cause_chain(e: BaseException):
@@ -185,9 +203,10 @@ def _is_nonretryable(e: BaseException) -> bool:
 
 def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
                 beat_fn: Optional[Callable] = None) -> dict:
-    """Returns {"metrics", "resumed_step"} — the resume step rides the
-    payload because on the Ray path the worker context lives in another
-    process and the driver could not read it otherwise."""
+    """Returns {"metrics", "resumed_step", "goodput",
+    "plan_fingerprint"} — attempt metadata rides the payload because on
+    the Ray path the worker context lives in another process and the
+    driver could not read it otherwise."""
     os.environ.update(env)
     from gke_ray_train_tpu.analysis.guards import (
         install_recompile_limit, uninstall_recompile_limit)
@@ -199,10 +218,26 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     # the worker's declarative ExecutionPlan (plan.py): resolved from
     # the same config+env the loop fn will read, logged up front so
     # every attempt states the plan identity it runs under. Purely
-    # static — no backend is touched before distributed_init.
+    # static — no backend is touched before distributed_init. Under an
+    # elastic pool override the plan is re-resolved on the survivors
+    # (the entry/worker fn does the same via rayint/elastic.py), so the
+    # logged identity — and the compile-cache namespace — match what
+    # the attempt actually compiles.
     plan = None
     try:
         plan = ExecutionPlan.resolve(config)
+        pool = os.environ.get("ELASTIC_N_DEVICES")
+        try:
+            pool_n = int(pool) if pool else None
+        except ValueError:
+            # same degrade as elastic_devices(): a malformed override
+            # must not kill the attempt (and burn a failure slot)
+            logger.warning("ELASTIC_N_DEVICES=%r is not an int; "
+                           "ignoring the pool override", pool)
+            pool_n = None
+        if pool_n and pool_n != plan.chips:
+            from gke_ray_train_tpu.plan import replan
+            plan = replan(plan, pool_n)
         logger.info("execution plan %s (topology %s)",
                     plan.fingerprint(), plan.topology)
     except PlanError as e:
@@ -218,6 +253,8 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     enable_persistent_cache(plan=plan)
     ctx = get_context()
     ctx.resumed_step = None      # fresh attempt, fresh metadata
+    ctx.goodput = None
+    ctx.plan_fingerprint = plan.fingerprint() if plan is not None else None
     ctx.set_heartbeat_sink(beat_fn)
     preempt.reset()              # a retry must not inherit the previous
     preempt.install()            # attempt's preemption flag
@@ -232,7 +269,9 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         ret = fn(config)
         reported = ctx.last_reported
         return {"metrics": ret if ret is not None else (reported or {}),
-                "resumed_step": ctx.resumed_step}
+                "resumed_step": ctx.resumed_step,
+                "goodput": ctx.goodput,
+                "plan_fingerprint": ctx.plan_fingerprint}
     finally:
         # one line of compile-cache health per attempt: a warm restart
         # should show hits ≈ compile count and seconds saved
@@ -279,13 +318,58 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.use_ray = (_HAS_RAY and self.scaling.num_workers >= 1
                         if use_ray is None else use_ray)
+        # surviving device count of the last elastic pool change; when
+        # set, every subsequent attempt's workers see it as
+        # ELASTIC_N_DEVICES and re-form their mesh on it
+        self._pool_override: Optional[int] = None
+
+    # -- elastic knobs -------------------------------------------------
+    def _elastic(self) -> bool:
+        if self.run_config.elastic is not None:
+            return bool(self.run_config.elastic)
+        from gke_ray_train_tpu.rayint.elastic import elastic_enabled
+        return elastic_enabled(self.config)
+
+    def _min_devices(self) -> int:
+        if self.run_config.min_devices is not None:
+            return max(int(self.run_config.min_devices), 1)
+        from gke_ray_train_tpu.rayint.elastic import min_devices
+        return min_devices(self.config)
+
+    def _pool_env(self) -> Dict[str, str]:
+        """Per-attempt worker env for the elastic pool override."""
+        env: Dict[str, str] = {}
+        # a RunConfig(elastic=True) opt-in must reach the worker-side
+        # gate too (rayint/elastic.py reads config/env only) — else the
+        # driver arms the override and the workers refuse to replan
+        if self.run_config.elastic:
+            env["ELASTIC"] = "1"
+        if self._pool_override is not None:
+            env["ELASTIC_N_DEVICES"] = str(self._pool_override)
+            return env
+        # local path shares os.environ across attempts — a cleared
+        # override must not leave a stale pool behind
+        os.environ.pop("ELASTIC_N_DEVICES", None)
+        return env
+
+    def _probe_pool(self) -> Optional[int]:
+        """Post-mortem device-pool probe for failures whose exception
+        carried no pool notice: the fault registry's emulated pool
+        in-process (the CPU drill), best-effort and None elsewhere —
+        a graceful pool change always arrives on Preempted.pool."""
+        try:
+            from gke_ray_train_tpu.testing.faults import current_pool
+            return current_pool()
+        except Exception:  # noqa: BLE001 - probe is best-effort
+            return None
 
     # -- local ---------------------------------------------------------
     def _fit_local(self) -> tuple:
         from gke_ray_train_tpu.rayint.context import get_context
         from gke_ray_train_tpu.rayint.supervisor import (
             HeartbeatBoard, HeartbeatTimeout, Watchdog)
-        env = {"NUM_PROCESSES": "1", "PROCESS_ID": "0"}
+        env = {"NUM_PROCESSES": "1", "PROCESS_ID": "0",
+               **self._pool_env()}
         hb = self.run_config.heartbeat_timeout_s
         board = HeartbeatBoard() if hb else None
         wd = Watchdog(board, hb).start() if hb else None
@@ -301,7 +385,7 @@ class JaxTrainer:
                 if wd is not None:
                     wd.stop()
                 get_context().set_heartbeat_sink(None)
-            return Result(metrics=out["metrics"]), out["resumed_step"]
+            return Result(metrics=out["metrics"]), out
         except KeyboardInterrupt:
             # the watchdog interrupts the main thread on stall (the only
             # way to pry a single process out of a wedged collective);
@@ -369,12 +453,31 @@ class JaxTrainer:
                 return _run_worker(fn, config, env, beat_fn=beat)
 
         hb_timeout = self.run_config.heartbeat_timeout_s
+        # slice identity (rank → slice, the slice_index contract): with
+        # NUM_SLICES declared, contiguous worker blocks form slices —
+        # the same layout parallel/mesh.py emulates — so a stall/loss
+        # confined to one slice is reported (and classified) as a
+        # slice-scoped event, not an anonymous whole-job failure
+        try:
+            num_slices = int(self.config.get(
+                "NUM_SLICES", os.environ.get("NUM_SLICES", "1")))
+        except (TypeError, ValueError):
+            num_slices = 1
+        # rank → slice through the ONE contract function (its non-
+        # tiling fallback collapses to a single domain, which carries
+        # no slice-scoping information — treat it as no slice identity)
+        from gke_ray_train_tpu.parallel.mesh import slice_assignments
+        assign = slice_assignments(list(range(n)), num_slices)
+        slice_map = (dict(enumerate(assign))
+                     if len(set(assign)) > 1 else None)
         supervisor = None
         if hb_timeout:
             from gke_ray_train_tpu.rayint.supervisor import (
                 HeartbeatTimeout, Supervisor)
             # tiny bookkeeping actor; released with its handle at return
             supervisor = ray.remote(Supervisor).options(num_cpus=0).remote()
+            if slice_map:
+                supervisor.set_slices.remote(slice_map)
 
         # honor placement_strategy: one bundle per worker, SPREAD puts
         # each TPU worker on its own host (the declared-but-unused
@@ -434,6 +537,13 @@ class JaxTrainer:
             from gke_ray_train_tpu.plan import ENV_FORWARD_KEYS
             env_base.update({k: os.environ[k] for k in ENV_FORWARD_KEYS
                              if k in os.environ})
+            # elastic knobs + the per-attempt pool override ride to the
+            # workers the same way (rayint/elastic.py)
+            env_base.update({k: os.environ[k]
+                             for k in ("ELASTIC", "MIN_DEVICES",
+                                       "NUM_SLICES")
+                             if k in os.environ})
+            env_base.update(self._pool_env())
             futures = [
                 w.run.remote(self.fn, self.config,
                              {**env_base, "PROCESS_ID": str(i)}, supervisor)
@@ -483,7 +593,8 @@ class JaxTrainer:
                             supervisor.stalled.remote(hb_timeout))
                         if stalled:
                             self._kill_workers(workers)
-                            raise HeartbeatTimeout(stalled, hb_timeout)
+                            raise HeartbeatTimeout(stalled, hb_timeout,
+                                                   slice_map=slice_map)
                     if deadline is not None and \
                             time.monotonic() >= deadline:
                         stalled_idx = sorted(
@@ -507,49 +618,140 @@ class JaxTrainer:
         return Result(
             metrics=results[0]["metrics"] if results else {},
             worker_metrics=[r["metrics"] for r in results]), \
-            (results[0]["resumed_step"] if results else None)
+            (results[0] if results else {})
+
+    def _local_attempt_note(self, p) -> tuple:
+        """(ledger, plan_fingerprint) of a failed/preempted attempt:
+        Preempted carries its ledger across process boundaries; on the
+        local path the loop's finally parked both on the context even
+        when the attempt crashed."""
+        led = getattr(p, "ledger", None) if p is not None else None
+        fp = None
+        if not self.use_ray:
+            try:
+                from gke_ray_train_tpu.rayint.context import get_context
+                ctx = get_context()
+                led = led if led is not None else ctx.goodput
+                fp = ctx.plan_fingerprint
+            except Exception:  # noqa: BLE001 - metadata is best-effort
+                pass
+        return led, fp
 
     def fit(self) -> Result:
+        from gke_ray_train_tpu.train.metrics import (
+            finish_ledger, sum_ledgers)
         fc = self.run_config.failure_config
         backoff_base = self.run_config.retry_backoff_s
         if backoff_base is None:
             backoff_base = float(os.environ.get("RETRY_BACKOFF_S", "1.0"))
+        elastic = self._elastic()
+        min_dev = self._min_devices()
         failures = 0
         preemptions = 0
         attempt = 0
         attempt_log: list = []
+
+        def finalize(result: Result) -> Result:
+            result.attempts = attempt
+            result.preemptions = preemptions
+            result.attempt_log = attempt_log
+            result.goodput = sum_ledgers(
+                [e["goodput"] for e in attempt_log if "goodput" in e])
+            return result
+
+        def classify_pool(p, entry, exc=None) -> Optional[Result]:
+            """Elastic post-mortem: did the device pool change? Reads
+            the pool off the preemption notice, the fault registry's
+            emulated pool, or — for a heartbeat stall whose stalled
+            ranks all sit on ONE slice — the slice-loss arithmetic.
+            Records the shrink/grow event on the attempt entry, arms
+            the override for the next attempt's workers, and returns a
+            terminal Result when the survivors are below MIN_DEVICES."""
+            pool = getattr(p, "pool", None) if p is not None else None
+            if pool is None:
+                pool = self._probe_pool()
+            if pool is None and exc is not None:
+                from gke_ray_train_tpu.rayint.supervisor import (
+                    HeartbeatTimeout, slice_shrink_pool)
+                for x in _cause_chain(exc):
+                    if isinstance(x, HeartbeatTimeout) \
+                            and x.uniform_slice is not None:
+                        entry["slice"] = x.uniform_slice
+                        per = float(self.scaling.resources_per_worker
+                                    .get("TPU", 0))
+                        if per > 0:
+                            pool = slice_shrink_pool(
+                                x.uniform_slice, x.slice_map, per)
+                        break
+            if pool is None or pool == self._pool_override or not elastic:
+                return None
+            prev = self._pool_override
+            event = "shrink" if prev is None or pool < prev else "grow"
+            entry["event"] = event
+            entry["pool"] = int(pool)
+            if pool < min_dev:
+                msg = (f"device pool shrank to {pool} (< MIN_DEVICES="
+                       f"{min_dev}); refusing to re-form — raise the "
+                       "floor knowingly or wait for capacity")
+                logger.error("%s", msg)
+                entry["status"] = "failed"
+                entry["error"] = msg
+                return finalize(Result(metrics={}, error=msg,
+                                       status="failed"))
+            self._pool_override = int(pool)
+            logger.warning(
+                "elastic %s event: next attempt re-forms the mesh on "
+                "%d devices (restore reshards from the logical spec)",
+                event, pool)
+            return None
+
         while True:
             attempt += 1
+            t_attempt = time.perf_counter()
             try:
-                result, resumed_step = self._fit_ray() if self.use_ray \
+                result, out = self._fit_ray() if self.use_ray \
                     else self._fit_local()
-                attempt_log.append({
-                    "status": "ok", "resumed_step": resumed_step})
-                result.attempts = attempt
-                result.preemptions = preemptions
-                result.attempt_log = attempt_log
-                return result
+                entry = {
+                    "status": "ok",
+                    "resumed_step": out.get("resumed_step"),
+                    "goodput": finish_ledger(
+                        out.get("goodput"),
+                        time.perf_counter() - t_attempt)}
+                if out.get("plan_fingerprint"):
+                    entry["plan_fingerprint"] = out["plan_fingerprint"]
+                if self._pool_override is not None:
+                    entry["pool"] = self._pool_override
+                attempt_log.append(entry)
+                return finalize(result)
             except Exception as e:  # noqa: BLE001 - classified below
+                wall = time.perf_counter() - t_attempt
                 p = _find_preempted(e)
+                led, fp = self._local_attempt_note(p)
+                goodput = finish_ledger(led, wall)
                 if p is not None:
                     # preempted: checkpointed within the grace window and
                     # exited cleanly — not a failure, does NOT consume
                     # max_failures; bounded by its own budget
                     preemptions += 1
-                    attempt_log.append({
+                    entry = {
                         "status": "preempted",
                         "step": getattr(p, "step", None),
                         "resumed_step": getattr(p, "resumed_step", None),
-                        "ckpt_save_s": getattr(p, "save_s", None)})
+                        "ckpt_save_s": getattr(p, "save_s", None),
+                        "goodput": goodput}
+                    if fp:
+                        entry["plan_fingerprint"] = fp
+                    attempt_log.append(entry)
+                    stop = classify_pool(p, entry)
+                    if stop is not None:
+                        return stop
                     if preemptions > fc.max_preemptions:
                         logger.error(
                             "preemption budget exhausted "
                             "(max_preemptions=%d): %s",
                             fc.max_preemptions, e)
-                        return Result(
-                            metrics={}, error=str(e), status="preempted",
-                            attempts=attempt, preemptions=preemptions,
-                            attempt_log=attempt_log)
+                        return finalize(Result(metrics={}, error=str(e),
+                                               status="preempted"))
                     logger.warning(
                         "attempt %d preempted (%s); resuming from the "
                         "saved checkpoint (preemption %d/%d; max_failures "
@@ -562,23 +764,49 @@ class JaxTrainer:
                         "retrying (a deterministic error fails "
                         "identically every attempt)", attempt,
                         type(e).__name__)
-                    attempt_log.append({"status": "failed",
-                                        "error": str(e),
-                                        "nonretryable": True})
-                    return Result(metrics={}, error=str(e),
-                                  status="failed", attempts=attempt,
-                                  preemptions=preemptions,
-                                  attempt_log=attempt_log)
+                    entry = {"status": "failed", "error": str(e),
+                             "nonretryable": True, "goodput": goodput}
+                    if fp:
+                        entry["plan_fingerprint"] = fp
+                    attempt_log.append(entry)
+                    return finalize(Result(metrics={}, error=str(e),
+                                           status="failed"))
+                # a failure whose post-mortem shows the pool changed
+                # (slice eviction without grace, heartbeat stall with
+                # the slice-loss signature) is a SHRINK event, not a
+                # max_failures burn — the hardware leaving is not the
+                # job's fault any more than a polite SIGTERM is
+                entry = {"status": "failed", "error": str(e),
+                         "goodput": goodput}
+                if fp:
+                    entry["plan_fingerprint"] = fp
+                attempt_log.append(entry)
+                stop = classify_pool(None, entry, exc=e)
+                if stop is not None:
+                    return stop
+                if entry.get("event"):
+                    entry["status"] = "preempted"
+                    preemptions += 1
+                    if preemptions > fc.max_preemptions:
+                        logger.error(
+                            "preemption budget exhausted "
+                            "(max_preemptions=%d): %s",
+                            fc.max_preemptions, e)
+                        return finalize(Result(metrics={}, error=str(e),
+                                               status="preempted"))
+                    logger.warning(
+                        "attempt %d lost to a pool change (%s); "
+                        "re-forming on %d devices (preemption %d/%d; "
+                        "max_failures budget untouched)", attempt, e,
+                        entry["pool"], preemptions, fc.max_preemptions)
+                    continue
                 failures += 1
-                attempt_log.append({"status": "failed", "error": str(e)})
                 logger.exception(
                     "training attempt %d failed (failure %d/%d)",
                     attempt, failures, fc.max_failures)
                 if failures > fc.max_failures:
-                    return Result(metrics={}, error=str(e),
-                                  status="failed", attempts=attempt,
-                                  preemptions=preemptions,
-                                  attempt_log=attempt_log)
+                    return finalize(Result(metrics={}, error=str(e),
+                                           status="failed"))
                 # exponential backoff + jitter: a mass restart (whole
                 # slice lost) must not thundering-herd the coordinator
                 delay = min(backoff_base * (2 ** (failures - 1)), 60.0)

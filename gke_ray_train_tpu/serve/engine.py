@@ -294,7 +294,8 @@ class BatchEngine:
                 self.plan, None, self._decode_fn,
                 aparams, astate, alora,
                 donate_argnums=(1,), sidecar=self._sidecar(kind, width),
-                label=f"serve_decode_b{width}")
+                label=f"serve_decode_b{width}",
+                surface="serve")
         elif kind == "prefill":
             aprompt = jax.ShapeDtypeStruct((1, width), jnp.int32)
             alen = jax.ShapeDtypeStruct((1,), jnp.int32)
@@ -302,7 +303,8 @@ class BatchEngine:
                 self.plan, None, self._prefill_fn,
                 aparams, aprompt, alen, alora,
                 donate_argnums=(), sidecar=self._sidecar(kind, width),
-                label=f"serve_prefill_b{width}")
+                label=f"serve_prefill_b{width}",
+                surface="serve")
         else:  # insert
             row_cache = jax.eval_shape(
                 partial(init_cache, self.cfg, 1, width))
@@ -316,7 +318,8 @@ class BatchEngine:
                 # cannot alias into the pooled [B, L] buffer, and jax
                 # warns on every unusable donation
                 donate_argnums=(0,), sidecar=self._sidecar(kind, width),
-                label=f"serve_insert_b{width}")
+                label=f"serve_insert_b{width}",
+                surface="serve")
         self._compiled[key] = fn
         return fn
 

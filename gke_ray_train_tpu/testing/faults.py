@@ -19,13 +19,24 @@ Fields:
   ``hang`` (sleep ``seconds`` — a wedged collective), ``sigterm``
   (deliver a preemption, ``train/preempt.py``), ``ckpt_truncate``
   (corrupt the newest checkpoint step on disk — an interrupted async
-  save's torn tail).
+  save's torn tail), ``pool_shrink`` (the spot pool changes to ``to``
+  devices: the pool registry records the new size and a preemption
+  carrying it is delivered — the elastic shrink/grow drill,
+  ``rayint/trainer.py``), ``slice_evict`` (one whole slice is evicted:
+  like ``pool_shrink`` but the surviving count is derived from the
+  slice layout — ``parallel/mesh.py::slice_assignments`` — and the
+  evicted slice is named).
 - ``step`` (required int): global step AFTER which the fault fires
   (the loop calls ``on_step`` once per completed step).
 - ``rank`` (int or ``*``, default ``*``): which worker fires it.
 - ``seconds`` (float, ``hang`` only, default 3600): hang duration —
   finite so an undetected hang still ends, but far beyond any
   reasonable ``HEARTBEAT_TIMEOUT_S``.
+- ``to`` (int, ``pool_shrink`` only, required): the surviving device
+  count. A ``to`` LARGER than the current pool is a *grow* event (the
+  nodepool returned) — same grammar, classified by comparison.
+- ``slice`` (int, ``slice_evict`` only, default: the last slice): which
+  slice the eviction removes.
 
 Each entry fires at most once per RUN, mirroring a real one-shot
 hardware event: the fired-registry is module-global (an in-process
@@ -48,8 +59,9 @@ from typing import List, Optional
 
 logger = logging.getLogger(__name__)
 
-KINDS = ("kill", "hang", "sigterm", "ckpt_truncate")
-_FIELDS = ("rank", "kind", "step", "seconds")
+KINDS = ("kill", "hang", "sigterm", "ckpt_truncate", "pool_shrink",
+         "slice_evict")
+_FIELDS = ("rank", "kind", "step", "seconds", "to", "slice")
 
 
 class InjectedKill(RuntimeError):
@@ -62,6 +74,8 @@ class FaultSpec:
     step: int
     rank: str = "*"          # "*" or the decimal rank
     seconds: float = 3600.0  # hang duration
+    to: Optional[int] = None       # pool_shrink: surviving device count
+    slice: Optional[int] = None    # slice_evict: which slice dies
 
     def matches(self, rank: int, step: int) -> bool:
         return self.step == step and (
@@ -99,9 +113,22 @@ def parse_fault_spec(spec: str) -> List[FaultSpec]:
         rank = fields.get("rank", "*")
         if rank != "*":
             int(rank)  # fail fast on garbage
+        if fields["kind"] == "pool_shrink" and "to" not in fields:
+            raise ValueError(
+                f"FAULT_SPEC kind=pool_shrink needs to=<surviving "
+                f"device count> (entry {entry!r})")
+        for f, kinds in (("to", ("pool_shrink",)),
+                         ("slice", ("slice_evict",)),
+                         ("seconds", ("hang",))):
+            if f in fields and fields["kind"] not in kinds:
+                raise ValueError(
+                    f"FAULT_SPEC field {f}= only applies to kind in "
+                    f"{kinds} (entry {entry!r})")
         out.append(FaultSpec(
             kind=fields["kind"], step=int(fields["step"]), rank=rank,
-            seconds=float(fields.get("seconds", 3600.0))))
+            seconds=float(fields.get("seconds", 3600.0)),
+            to=int(fields["to"]) if "to" in fields else None,
+            slice=int(fields["slice"]) if "slice" in fields else None))
     return out
 
 
@@ -115,6 +142,57 @@ MARKER_NAME = ".fault_spec_fired"
 
 def reset_fired() -> None:
     _FIRED.clear()
+
+
+# ---------------------------------------------------------------------------
+# emulated device pool — the infrastructure state behind pool faults
+# ---------------------------------------------------------------------------
+
+# current emulated pool size (None = the full physical pool). Unlike
+# the fired-fault registry this is INFRASTRUCTURE state, not per-attempt
+# state: a shrunken pool stays shrunken across retries until a grow
+# event, exactly like a real spot nodepool. Persisted beside the
+# checkpoints so a fresh Ray worker process sees the same pool.
+_POOL: Optional[int] = None
+
+POOL_MARKER_NAME = ".elastic_pool"
+
+
+def set_pool(n_devices: int, ckpt_manager=None) -> None:
+    """Record the emulated pool size (and persist it beside the
+    checkpoints when a manager is bound)."""
+    global _POOL
+    _POOL = int(n_devices)
+    if ckpt_manager is None:
+        return
+    try:
+        with open(os.path.join(str(ckpt_manager.directory),
+                               POOL_MARKER_NAME), "w") as f:
+            f.write(str(_POOL))
+    except OSError as e:  # pragma: no cover - marker is best-effort
+        logger.debug("could not persist pool marker: %s", e)
+
+
+def current_pool(ckpt_dir: Optional[str] = None) -> Optional[int]:
+    """The emulated pool size: in-process registry first, then the
+    persisted marker (fresh worker processes), else None (= full pool).
+    This is what the trainer's post-mortem probes after a failure whose
+    exception carried no pool notice."""
+    if _POOL is not None:
+        return _POOL
+    if ckpt_dir:
+        try:
+            with open(os.path.join(str(ckpt_dir),
+                                   POOL_MARKER_NAME)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            pass
+    return None
+
+
+def reset_pool() -> None:
+    global _POOL
+    _POOL = None
 
 
 class FaultInjector:
@@ -149,7 +227,12 @@ class FaultInjector:
         return os.path.join(str(self.ckpt_manager.directory), MARKER_NAME)
 
     def _marker_key(self, spec: FaultSpec) -> str:
-        return f"rank{self.rank}:{spec.kind}@{spec.step}:match={spec.rank}"
+        key = f"rank{self.rank}:{spec.kind}@{spec.step}:match={spec.rank}"
+        if spec.to is not None:
+            key += f":to={spec.to}"
+        if spec.slice is not None:
+            key += f":slice={spec.slice}"
+        return key
 
     def _already_fired(self, spec: FaultSpec) -> bool:
         if (self.rank, spec) in _FIRED:
@@ -197,6 +280,50 @@ class FaultInjector:
             preempt.trigger()
         elif spec.kind == "ckpt_truncate":
             self._truncate_latest(step)
+        elif spec.kind == "pool_shrink":
+            self._pool_change(spec.to, step, reason="pool_shrink")
+        elif spec.kind == "slice_evict":
+            survivors, evicted = self._slice_evict_target(spec)
+            self._pool_change(survivors, step,
+                              reason=f"slice_evict:slice={evicted}")
+
+    def _pool_change(self, n_devices: int, step: int,
+                     reason: str) -> None:
+        """A pool-change notice, delivered the way the platform would:
+        the surviving pool size lands in the registry (infrastructure
+        state — it outlives the attempt) and a preemption carrying it
+        is requested, so the loop grace-saves and the trainer's
+        post-mortem re-forms the mesh on the survivors instead of
+        burning a failure-budget slot."""
+        set_pool(n_devices, self.ckpt_manager)
+        from gke_ray_train_tpu.train import preempt
+        preempt.request(source=f"{reason}@step{step}", pool=n_devices)
+
+    def _slice_evict_target(self, spec: FaultSpec):
+        """(surviving device count, evicted slice index) for a
+        slice_evict fault — slice identity per the slice_index contract
+        (``parallel/mesh.py::slice_assignments``; NUM_SLICES drives the
+        emulated layout on fake/CPU devices)."""
+        import jax
+
+        from gke_ray_train_tpu.parallel.mesh import slice_assignments
+        devices = jax.devices()
+        # default 1 like every other slice_index consumer — an unset
+        # NUM_SLICES is a single-domain pool, and evicting its only
+        # slice errors loudly below instead of fabricating a layout
+        num_slices = int(os.environ.get("NUM_SLICES", "1"))
+        assign = slice_assignments(devices, num_slices)
+        evicted = spec.slice if spec.slice is not None else max(assign)
+        if evicted not in assign:
+            raise RuntimeError(
+                f"FAULT_SPEC slice_evict: slice {evicted} does not "
+                f"exist (slices present: {sorted(set(assign))})")
+        survivors = sum(1 for s in assign if s != evicted)
+        if survivors == 0:
+            raise RuntimeError(
+                "FAULT_SPEC slice_evict would evict the ENTIRE pool — "
+                "use kind=sigterm for a whole-job eviction")
+        return survivors, evicted
 
     def _truncate_latest(self, step: int) -> None:
         """Tear the newest checkpoint step the way an interrupted async

@@ -29,7 +29,8 @@ import numpy as np
 from gke_ray_train_tpu.analysis.guards import RuntimeGuards, allow_transfers
 from gke_ray_train_tpu.data.prefetch import make_batch_source
 from gke_ray_train_tpu.train import preempt
-from gke_ray_train_tpu.train.metrics import ThroughputMeter, paused
+from gke_ray_train_tpu.train.metrics import (
+    GoodputLedger, ThroughputMeter, paused)
 from gke_ray_train_tpu.train.step import TrainState
 
 logger = logging.getLogger(__name__)
@@ -128,6 +129,10 @@ def run_training(state: TrainState,
     # is a deserialized AOT executable; perf/cache.py)
     t_loop0 = time.perf_counter()
     loop_timing: dict = {}
+    # per-attempt goodput ledger (train/metrics.py): every second of
+    # this call decomposes into LEDGER_TERMS; the trainer reads it off
+    # the context (or the Preempted exception) into Result.goodput
+    ledger = GoodputLedger()
     if guards is None:
         guards = RuntimeGuards.from_config()
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
@@ -139,6 +144,7 @@ def run_training(state: TrainState,
         fault_injector.bind_ckpt(ckpt_manager)
     resumed_step = None
     if ckpt_manager is not None:
+        t_restore0 = time.perf_counter()
         try:
             view, resumed = ckpt_manager.restore_if_available(
                 save_view(state))
@@ -157,6 +163,7 @@ def run_training(state: TrainState,
             full, resumed = ckpt_manager.restore_if_available(state)
             if resumed is not None:
                 state = full
+        ledger.note("restore_s", time.perf_counter() - t_restore0)
         if resumed is not None and is_host0:
             logger.info("resumed at step %d", resumed)
         resumed_step = resumed
@@ -215,6 +222,7 @@ def run_training(state: TrainState,
                                       force=True)
                 ckpt_manager.wait()
             save_s = time.perf_counter() - t0
+            ledger.note("eval_ckpt_stall_s", save_s)
             kept = ckpt_manager.latest_step()
             if kept != step:
                 # best-by-score retention can delete a forced save whose
@@ -232,8 +240,16 @@ def run_training(state: TrainState,
                     "preemption: checkpoint at step %d durable in %.2fs "
                     "(grace remaining: %s s)", step, save_s,
                     preempt.remaining_grace_s())
+        # close the ledger NOW so it rides the exception (the finally
+        # below re-closes idempotently) — a preempted attempt's ledger
+        # must survive process boundaries on the Ray path, and a pool-
+        # change notice carries the surviving device count for the
+        # trainer's elastic re-form
+        ledger.close(time.perf_counter() - t_loop0)
         raise preempt.Preempted(step=step, resumed_step=resumed_step,
-                                save_s=save_s, grace_s=preempt.grace_s())
+                                save_s=save_s, grace_s=preempt.grace_s(),
+                                pool=preempt.pool_target(),
+                                ledger=ledger.as_dict())
     # resume fast-forward (HF Trainer resume_from_checkpoint semantics):
     # batches the restored step counter already consumed are SKIPPED, not
     # retrained — the epoch iterators are seeded by epoch index, so
@@ -272,14 +288,18 @@ def run_training(state: TrainState,
             if _preempt_requested():
                 _preempt_exit(state, m, global_step)
             wait_s = source.consume_wait()
-            if trained_this_epoch == 0 and meter is not None:
+            if trained_this_epoch == 0:
                 # fast-forwarding consumed batches costs wall clock
                 # (tokenize/pack) that must not deflate the tokens/sec
                 # window of the steps actually trained — the reset also
-                # drops the first batch's pipeline-warmup wait
-                meter.reset()
-            elif meter is not None:
-                meter.data_wait(wait_s)
+                # drops the first batch's pipeline-warmup wait (the
+                # ledger books that span as fast_forward, below)
+                if meter is not None:
+                    meter.reset()
+            else:
+                if meter is not None:
+                    meter.data_wait(wait_s)
+                ledger.data_wait(wait_s)
             trained_this_epoch += 1
             if not loop_timing:
                 # DIVERGENCE_GUARD (multi-host, opt-in): every host
@@ -296,6 +316,19 @@ def run_training(state: TrainState,
                     "compile_s": now - t_step0,
                     "restart_to_first_step_s": now - t_loop0,
                 }
+                # ledger decomposition of the restart window: restore
+                # was timed directly; the first step call is compile;
+                # on a RESUMED attempt everything else between entry
+                # and the first completed step IS the fast-forward
+                # (iterator replay, guard checks, pipeline warmup). A
+                # fresh start fast-forwarded nothing — its warmup stays
+                # in step_s rather than fabricating resume time.
+                ledger.note("compile_s", loop_timing["compile_s"])
+                if resumed_step is not None:
+                    ledger.note(
+                        "fast_forward_s",
+                        loop_timing["restart_to_first_step_s"]
+                        - loop_timing["compile_s"] - ledger.restore_s)
             else:
                 state, m = train_step(state, batch)
             global_step += 1
@@ -335,7 +368,7 @@ def run_training(state: TrainState,
                 # compute is booked as training, not stall
                 if meter is not None:
                     jax.block_until_ready(m)
-                with paused(meter), allow_transfers():
+                with paused(meter), paused(ledger), allow_transfers():
                     eval_metrics = eval_fn(state)
                 last_metrics.update(eval_metrics)
                 if tb_writer is not None:
@@ -347,7 +380,7 @@ def run_training(state: TrainState,
             if ckpt_manager is not None and ckpt_every and \
                     global_step % ckpt_every == 0:
                 m_host = _fetch_metrics(m)
-                with paused(meter), allow_transfers():
+                with paused(meter), paused(ledger), allow_transfers():
                     ckpt_manager.save(global_step, save_view(state),
                                       metrics=m_host)
             if fault_injector is not None:
@@ -384,19 +417,25 @@ def run_training(state: TrainState,
         if meter is not None:
             epoch_metrics.update(meter.snapshot())
         if eval_fn is not None and eval_at_epoch_end:
-            with allow_transfers():
+            with paused(ledger), allow_transfers():
                 epoch_metrics.update(eval_fn(state))
         if tb_writer is not None:
             tb_writer.log(global_step, epoch_metrics)
             tb_writer.flush()
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
-            with allow_transfers():
+            with paused(ledger), allow_transfers():
                 ckpt_manager.save(global_step, save_view(state),
                                   metrics=m_host)
         if report_fn is not None:
             report_fn(epoch_metrics)
     finally:
+        # seal the attempt's goodput ledger on EVERY exit path (normal,
+        # Preempted — already closed there, idempotent — and crash) and
+        # park it on the context for Result.attempt_log / Result.goodput
+        ledger.close(time.perf_counter() - t_loop0)
+        from gke_ray_train_tpu.rayint.context import get_context
+        get_context().note_goodput(ledger.as_dict())
         # leave the transfer-guard region before the post-loop export/
         # merge work — only the hot loop is guarded
         _guard_region.close()

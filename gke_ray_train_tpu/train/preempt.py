@@ -39,23 +39,36 @@ _deadline: Optional[float] = None   # monotonic end of the grace window
 _installed = False
 _prev_handler = None
 _lock = threading.Lock()
+# device-pool target attached to the preemption (elastic training): a
+# slice eviction / spot shrink notice names the SURVIVING chip count,
+# so the trainer's post-mortem can re-form the mesh instead of burning
+# retries waiting for the old topology (rayint/trainer.py)
+_pool: Optional[int] = None
 
 
 class Preempted(Exception):
     """The distinct "preempted" exit status of a training attempt.
 
     Carries the attempt metadata the trainer records: the step the loop
-    stopped at, the step it had resumed from, and how long the forced
-    checkpoint save took (must fit the grace window).
+    stopped at, the step it had resumed from, how long the forced
+    checkpoint save took (must fit the grace window), the surviving
+    device-pool size when the preemption was a pool-change notice
+    (elastic shrink/grow — ``pool``), and the attempt's goodput ledger
+    (``train/metrics.py``) so a preempted attempt's wall-clock
+    decomposition survives the exception path.
     """
 
     def __init__(self, step: int, resumed_step: Optional[int] = None,
                  save_s: Optional[float] = None,
-                 grace_s: Optional[float] = None):
+                 grace_s: Optional[float] = None,
+                 pool: Optional[int] = None,
+                 ledger: Optional[dict] = None):
         self.step = step
         self.resumed_step = resumed_step
         self.save_s = save_s
         self.grace_s = grace_s
+        self.pool = pool
+        self.ledger = ledger
         saved = (f"checkpoint durable in {save_s:.2f}s"
                  if save_s is not None else "no checkpoint manager — "
                  "nothing saved")
@@ -105,10 +118,15 @@ def uninstall() -> None:
         _prev_handler = None
 
 
-def request(source: str = "request") -> None:
+def request(source: str = "request", pool: Optional[int] = None) -> None:
     """Mark this process as preempted; the loop exits at the next step
-    boundary. Safe from signal handlers and any thread."""
-    global _deadline
+    boundary. Safe from signal handlers and any thread. ``pool`` names
+    the surviving device count when the preemption is a pool-change
+    notice (slice eviction / spot shrink / node return) — the trainer
+    reads it off the raised :class:`Preempted` and re-forms the mesh."""
+    global _deadline, _pool
+    if pool is not None:
+        _pool = int(pool)
     if not _flag.is_set():
         _deadline = time.monotonic() + grace_s()
         logger.warning(
@@ -116,6 +134,12 @@ def request(source: str = "request") -> None:
             "checkpoint at the next step boundary and exit 'preempted'",
             source, grace_s())
     _flag.set()
+
+
+def pool_target() -> Optional[int]:
+    """Surviving device count attached to the pending preemption, if
+    the notice was a pool change (None = plain eviction of this job)."""
+    return _pool
 
 
 def trigger() -> None:
@@ -141,6 +165,7 @@ def remaining_grace_s() -> Optional[float]:
 def reset() -> None:
     """Clear the flag (start of a fresh attempt — a retried attempt must
     not inherit the previous attempt's preemption)."""
-    global _deadline
+    global _deadline, _pool
     _flag.clear()
     _deadline = None
+    _pool = None

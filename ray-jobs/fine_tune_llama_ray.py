@@ -75,17 +75,26 @@ def train_loop_per_worker(config: dict):
     plan = ExecutionPlan.resolve(config)
     apply_debug_flags(config)
     distributed_init()
+    # elastic mesh re-formation (rayint/elastic.py): a shrunken/grown
+    # pool re-resolves the plan on the survivors (data/fsdp reflowed,
+    # global batch preserved, budget pin dropped) and the mesh is built
+    # on exactly those devices; the checkpoint restore below reshards
+    # from the logical spec. A no-op when ELASTIC is off. Replan BEFORE
+    # enabling the cache — the cache subdir is namespaced by the plan's
+    # compile fingerprint, which must be the survivors'.
+    from gke_ray_train_tpu.rayint.elastic import maybe_replan
+    plan, devices = maybe_replan(plan, config=config, log=logger)
     # persistent XLA compile cache (perf/cache.py): restarts and peer
     # hosts reuse the compiled binary; re-enabled post-init so the
     # cache dir carries the real device-topology fingerprint
     from gke_ray_train_tpu.perf.cache import enable_persistent_cache
     enable_persistent_cache(plan=plan)
-    mesh = plan.build_mesh()
+    mesh = plan.build_mesh(devices)
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
     smoke = bool(config.get("SMOKE_TEST", False))
     logger.info("worker %d/%d; %d devices; mesh %s; plan %s", host,
-                n_hosts, len(jax.devices()), dict(mesh.shape),
+                n_hosts, len(devices), dict(mesh.shape),
                 plan.fingerprint())
 
     # ---- tokenizer + model config ------------------------------------
@@ -347,7 +356,7 @@ def train_loop_per_worker(config: dict):
     # LoRA runs bill the 4N FLOP count (frozen base skips weight-grad
     # matmuls) so the logged MFU is honest (train/metrics.py)
     meter = ThroughputMeter(cfg, seq_len=max_seq,
-                            n_devices=len(jax.devices()),
+                            n_devices=len(devices),
                             trainable="lora" if use_lora else "full")
     # LoRA checkpoints persist only adapters + optimizer state: the
     # frozen (possibly NF4-quantized) base is rebuilt from the pretrained

@@ -63,13 +63,22 @@ def train_loop_per_worker(config: dict):
         max_seq_len=seq_len,
         prefetch=int(config.get("prefetch_batches",
                                 config.get("PREFETCH_BATCHES", 2))))
+    # elastic mesh re-formation (rayint/elastic.py): when the trainer's
+    # post-mortem shrank/grew the pool, re-resolve the plan on the
+    # survivors (data/fsdp reflowed, global batch preserved) and build
+    # the mesh on exactly those devices; restore below reshards from
+    # the logical spec. A no-op when ELASTIC is off or the pool is full.
+    # Replan BEFORE enabling the cache — the cache subdir is namespaced
+    # by the plan's compile fingerprint, which must be the survivors'.
+    from gke_ray_train_tpu.rayint.elastic import maybe_replan
+    plan, devices = maybe_replan(plan, config=config, log=logger)
     # persistent XLA compile cache on the shared PVC: the first worker
     # to compile pays; every restart (and every other host) reuses the
     # binary. Re-enabled here (the trainer already enabled it pre-init)
     # so the cache dir carries the real device-topology fingerprint.
     from gke_ray_train_tpu.perf.cache import enable_persistent_cache
     enable_persistent_cache(plan=plan)
-    mesh = plan.build_mesh()
+    mesh = plan.build_mesh(devices)
     n_hosts = max(jax.process_count(), 1)
     host = jax.process_index()
     logger.info("worker %d/%d; mesh %s; plan %s", host, n_hosts,
@@ -162,7 +171,7 @@ def train_loop_per_worker(config: dict):
         save_tokenizer(tok, run_dir)
 
     meter = ThroughputMeter(cfg, seq_len=seq_len,
-                            n_devices=len(jax.devices()))
+                            n_devices=len(devices))
     from gke_ray_train_tpu.train.profiling import profiler_from_config
     state, metrics = run_training(
         state, step_fn, lambda e: batches.iter_epoch(e),

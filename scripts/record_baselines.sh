@@ -49,6 +49,13 @@ run recovery       env BENCH_MODE=recovery python bench.py
 # vs deserialized AOT executable, + the compile-level StepCostReport
 run compile        env BENCH_MODE=compile python bench.py
 
+# elastic-training drill (canonical 8-fake-device CPU mesh, re-execs
+# itself there): injected pool shrink 8->4->8, mesh re-formed and the
+# checkpoint resumed RESHARDED at each change; the record carries the
+# goodput ledger, time-to-first-step-after-shrink, and the per-attempt
+# shrink/grow classification + plan fingerprints
+run elastic        env BENCH_MODE=elastic python bench.py
+
 # compile-cost budgets (tests/budgets/*.json) are recorded on the
 # canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI
 # re-execs itself there; `check` is what tier-1 runs. Only re-record

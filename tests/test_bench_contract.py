@@ -30,6 +30,59 @@ def test_mode_registry_consistent():
         assert m in modes, f"README advertises unknown mode {m!r}"
 
 
+def test_goodput_ledger_schema_pinned():
+    """The goodput ledger's term set is a cross-artifact contract: the
+    loop fills it, the trainer reconciles it, BENCH_MODE=elastic and
+    record_baselines.sh persist it, and the README documents it. Pin
+    the schema so a renamed term fails here instead of silently
+    un-reconciling old records."""
+    from gke_ray_train_tpu.train.metrics import (
+        LEDGER_TERMS, finish_ledger, sum_ledgers)
+    assert LEDGER_TERMS == ("compile_s", "restore_s", "fast_forward_s",
+                            "data_stall_s", "eval_ckpt_stall_s",
+                            "step_s", "lost_s")
+    # reconciliation identity: terms sum to wall-clock by construction
+    led = finish_ledger({"compile_s": 1.0, "step_s": 2.5}, 5.0)
+    assert abs(sum(led[t] for t in LEDGER_TERMS) - led["wall_s"]) < 1e-9
+    assert led["lost_s"] == 1.5
+    total = sum_ledgers([led, finish_ledger(None, 3.0)])
+    assert total["wall_s"] == 8.0
+    assert total["goodput_frac"] == total["step_s"] / total["wall_s"]
+    # BENCH_MODE=elastic pins the same terms on its record
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"elastic": bench_elastic' in src
+    assert "LEDGER_TERMS" in src
+
+
+@pytest.mark.slow
+def test_bench_elastic_record_shape():
+    """BENCH_MODE=elastic emits one valid tagged record whose goodput
+    ledger carries exactly the pinned terms (+ wall_s/goodput_frac) and
+    whose events classify the shrink/grow as preemptions."""
+    from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
+    env.update(BENCH_MODE="elastic", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO, RETRY_BACKOFF_S="0", COMPILE_CACHE="0")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["value"] > 0
+    assert set(rec["goodput"]) == set(LEDGER_TERMS) | {"wall_s",
+                                                       "goodput_frac"}
+    assert rec["mesh_devices_per_attempt"] == [8, 4, 8]
+    assert len(rec["events"]) == rec["attempts"] == 3
+    assert [e.get("event") for e in rec["events"]] == \
+        ["shrink", "grow", None]
+    assert rec["preemptions"] == 2
+    assert rec["time_to_first_step_after_shrink_s"] > 0
+    assert rec["plan_fingerprint"]
+
+
 @pytest.mark.slow
 def test_bench_emits_one_json_line():
     env = {k: v for k, v in os.environ.items()

@@ -1,0 +1,540 @@
+"""Elastic training (ISSUE 8): mesh re-formation on shrink/grow,
+slice-scoped failure domains, and the per-attempt goodput ledger.
+
+Acceptance drill: save-on-fake-8 → injected pool shrink → resume
+RESHARDED on fake-4 → grow event → recover to fake-8, all inside one
+``JaxTrainer.fit`` call with ``max_failures=0`` (a pool change is a
+preemption-class event, never a failure-budget burn), with the loss
+trajectory continuous across both reshards and every attempt's goodput
+ledger reconciling to its wall-clock.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.ckpt import CheckpointManager
+from gke_ray_train_tpu.models import tiny
+from gke_ray_train_tpu.parallel.mesh import slice_assignments
+from gke_ray_train_tpu.parallel.placement import make_place_batch
+from gke_ray_train_tpu.plan import ExecutionPlan, PlanError, replan
+from gke_ray_train_tpu.rayint import FailureConfig, JaxTrainer, RunConfig
+from gke_ray_train_tpu.rayint.elastic import (
+    elastic_devices, elastic_enabled, maybe_replan, min_devices)
+from gke_ray_train_tpu.testing.faults import (
+    FaultInjector, parse_fault_spec, reset_fired, reset_pool)
+from gke_ray_train_tpu.train import (
+    make_optimizer, make_train_state, make_train_step, preempt)
+from gke_ray_train_tpu.train.loop import run_training
+from gke_ray_train_tpu.train.metrics import (
+    LEDGER_TERMS, GoodputLedger, finish_ledger, sum_ledgers)
+
+
+@pytest.fixture(autouse=True)
+def _clean_elastic_state(monkeypatch):
+    """Fault + pool registries are process-global by design; the
+    emulated pool is infrastructure state that must not leak between
+    tests (nor may a pool override env)."""
+    monkeypatch.delenv("FAULT_SPEC", raising=False)
+    monkeypatch.delenv("ELASTIC_N_DEVICES", raising=False)
+    monkeypatch.delenv("ELASTIC", raising=False)
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    yield
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    preempt.uninstall()
+
+
+# ---------------------------------------------------------------------
+# replan: reflow rules + feasibility rejections
+# ---------------------------------------------------------------------
+
+def test_replan_shrink_reflows_dp_axes_and_preserves_global_batch():
+    plan = ExecutionPlan.from_kwargs(data=1, fsdp=-1, per_device_batch=1,
+                                     topology="cpu-8")
+    small = replan(plan, 4)
+    assert small.resolved_sizes() == {"data": 1, "fsdp": 4, "model": 1,
+                                      "context": 1, "pipe": 1}
+    assert small.topology == "cpu-4" and small.chips == 4
+    # global batch preserved: 8 rows on 8 chips = 8 rows on 4 chips
+    assert small.global_batch() == plan.global_batch() == 8
+    assert small.per_device_batch == 2
+    # identity on the full pool — the grow-recovery path
+    assert replan(plan, plan.chips) is plan
+
+
+def test_replan_keeps_structural_axes():
+    plan = ExecutionPlan.from_kwargs(model=2, fsdp=-1, topology="cpu-8")
+    small = replan(plan, 4)
+    assert small.model == 2 and small.resolved_sizes()["fsdp"] == 2
+    # a pool that cannot tile the structural axes is surfaced (PLAN001
+    # class), not crashed
+    with pytest.raises(PlanError, match="structural"):
+        replan(plan, 3)
+
+
+def test_replan_model_dim_rejection_surfaced():
+    # heads=2 cannot tile a model axis that would need to be 4-wide —
+    # the PLAN002-class findings ride the PlanError
+    cfg = tiny(vocab_size=256, d_model=64, n_layers=2, n_heads=2,
+               n_kv_heads=2, d_ff=128)
+    plan = ExecutionPlan.from_kwargs(model=4, fsdp=-1, topology="cpu-8")
+    with pytest.raises(PlanError, match="n_heads|model"):
+        replan(plan, 4, model_cfg=dataclasses.replace(cfg, n_heads=2))
+
+
+def test_replan_repins_topology_and_drops_stale_budget():
+    plan = ExecutionPlan.from_kwargs(
+        data=2, fsdp=4, per_device_batch=1, max_seq_len=64,
+        donate_state=False, donate_batch=False, topology="cpu-8",
+        budget_preset="tiny_fsdp8")
+    small = replan(plan, 4)
+    # the recorded budget describes the OLD mesh's program — keeping
+    # the pin would trip PLAN004 as a false drift signal
+    assert small.budget_preset is None
+    assert small.topology == "cpu-4"
+    # non-preset survivor counts are still declarable
+    odd = replan(ExecutionPlan.from_kwargs(data=1, fsdp=-1,
+                                           topology="cpu-8"), 6)
+    assert odd.topology == "cpu-6" and odd.chips == 6
+
+
+def test_replan_shrinks_slices_proportionally():
+    plan = ExecutionPlan.from_kwargs(data=4, fsdp=2, num_slices=2,
+                                     topology="cpu-8")
+    # one whole slice evicted: 2 slices of 4 -> 1 slice of 4
+    small = replan(plan, 4)
+    assert small.num_slices == 1
+    assert small.resolved_sizes()["data"] * \
+        small.resolved_sizes()["fsdp"] == 4
+
+
+# ---------------------------------------------------------------------
+# fault grammar: pool_shrink / slice_evict
+# ---------------------------------------------------------------------
+
+def test_fault_grammar_pool_kinds():
+    specs = parse_fault_spec(
+        "rank=0:kind=pool_shrink:to=4:step=3;"
+        "rank=*:kind=slice_evict:slice=1:step=5")
+    assert specs[0].kind == "pool_shrink" and specs[0].to == 4
+    assert specs[1].kind == "slice_evict" and specs[1].slice == 1
+    with pytest.raises(ValueError, match="to="):
+        parse_fault_spec("kind=pool_shrink:step=3")      # missing to
+    with pytest.raises(ValueError, match="only applies"):
+        parse_fault_spec("kind=kill:to=4:step=3")        # to on kill
+    with pytest.raises(ValueError, match="only applies"):
+        parse_fault_spec("kind=pool_shrink:to=4:slice=1:step=3")
+
+
+def test_pool_fault_fires_once_with_persisted_registry(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), score_attribute=None,
+                            async_save=False)
+    spec = parse_fault_spec("rank=0:kind=pool_shrink:to=4:step=2")
+    inj = FaultInjector(spec, rank=0, ckpt_manager=mgr)
+    inj.on_step(2)
+    assert preempt.requested() and preempt.pool_target() == 4
+    from gke_ray_train_tpu.testing.faults import current_pool
+    assert current_pool() == 4
+    # fresh process (empty in-memory registry): the marker file keeps
+    # the fault spent AND the pool marker keeps the pool shrunken
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    FaultInjector(parse_fault_spec("rank=0:kind=pool_shrink:to=4:step=2"),
+                  rank=0, ckpt_manager=mgr).on_step(2)
+    assert not preempt.requested()
+    assert current_pool(str(mgr.directory)) == 4
+    mgr.close()
+
+
+def test_slice_evict_derives_pool_from_slice_layout(monkeypatch):
+    monkeypatch.setenv("NUM_SLICES", "2")
+    inj = FaultInjector(
+        parse_fault_spec("rank=0:kind=slice_evict:step=1"), rank=0)
+    inj.on_step(1)
+    # 8 fake devices, 2 emulated slices -> evicting the last slice
+    # leaves 4 survivors
+    assert preempt.pool_target() == 4
+    from gke_ray_train_tpu.testing.faults import current_pool
+    assert current_pool() == 4
+
+
+# ---------------------------------------------------------------------
+# slice identity: the slice_index contract + supervisor board
+# ---------------------------------------------------------------------
+
+def test_slice_assignments_contract(devices):
+    # fake/CPU devices: contiguous blocks (the emulated hybrid layout)
+    assert slice_assignments(devices, 2) == [0, 0, 0, 0, 1, 1, 1, 1]
+    assert slice_assignments(devices, 1) == [0] * 8
+    assert slice_assignments(devices, 3) == [0] * 8  # non-tiling: one domain
+
+    class FakeDev:
+        def __init__(self, s):
+            self.slice_index = s
+
+    # real hardware: .slice_index wins regardless of order
+    real = [FakeDev(1), FakeDev(0), FakeDev(1), FakeDev(0)]
+    assert slice_assignments(real, 2) == [1, 0, 1, 0]
+    # elastic pool emulation = truncation = the LAST slice evicted
+    assert slice_assignments(devices[:4], 2) == [0, 0, 1, 1]
+
+
+def test_heartbeat_board_slice_identity_and_uniform_slice():
+    from gke_ray_train_tpu.rayint.supervisor import (
+        HeartbeatBoard, HeartbeatTimeout, slice_shrink_pool)
+    board = HeartbeatBoard()
+    board.set_slices({0: 0, 1: 0, 2: 1, 3: 1})
+    board.beat(2, 5)
+    assert board.snapshot()[2]["slice"] == 1
+    stalled = [(2, 5, 9.0), (3, 5, 9.0)]
+    e = HeartbeatTimeout(stalled, 4.0, slice_map=board.slice_map())
+    assert e.uniform_slice == 1
+    assert "slice 1" in str(e) and "slice-loss signature" in str(e)
+    # a stall spanning slices is NOT a slice eviction
+    e2 = HeartbeatTimeout([(0, 5, 9.0), (2, 5, 9.0)], 4.0,
+                          slice_map=board.slice_map())
+    assert e2.uniform_slice is None
+    # survivors after writing off slice 1's workers, 4 chips each
+    assert slice_shrink_pool(1, board.slice_map(), 4) == 8
+
+
+# ---------------------------------------------------------------------
+# the goodput ledger
+# ---------------------------------------------------------------------
+
+def test_goodput_ledger_accounting():
+    led = GoodputLedger()
+    led.note("restore_s", 1.0)
+    led.note("compile_s", 2.0)
+    led.note("fast_forward_s", -5.0)     # clamped, never negative
+    led.data_wait(0.5)
+    led.pause()
+    led.resume()
+    led.close(10.0)
+    d = led.as_dict()
+    assert d["fast_forward_s"] == 0.0
+    assert d["step_s"] == pytest.approx(10.0 - 1.0 - 2.0 - 0.5
+                                        - d["eval_ckpt_stall_s"])
+    led.close(99.0)                      # idempotent
+    assert led.as_dict()["step_s"] == d["step_s"]
+    fin = finish_ledger(d, 12.0)
+    assert fin["lost_s"] == pytest.approx(2.0)
+    assert sum(fin[t] for t in LEDGER_TERMS) == pytest.approx(12.0)
+    total = sum_ledgers([fin, finish_ledger(None, 3.0)])
+    assert total["wall_s"] == pytest.approx(15.0)
+    assert total["lost_s"] == pytest.approx(5.0)
+    assert 0.0 <= total["goodput_frac"] <= 1.0
+
+
+# ---------------------------------------------------------------------
+# the acceptance drill: 8 -> 4 -> 8 through JaxTrainer.fit
+# ---------------------------------------------------------------------
+
+STEPS, SHRINK_AT, GROW_AT = 10, 4, 7
+B, S = 8, 16
+
+
+def _cfg():
+    return tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+                n_kv_heads=2, d_ff=64, dtype="float32",
+                param_dtype="float32")
+
+
+def _batches(epoch):
+    for i in range(STEPS):
+        rng = np.random.default_rng(epoch * 100 + i)
+        yield {"inputs": rng.integers(0, 64, (B, S)).astype(np.int32),
+               "targets": rng.integers(0, 64, (B, S)).astype(np.int32),
+               "weights": np.ones((B, S), np.float32)}
+
+
+def _elastic_worker(ckpt_dir, *, fault_spec=None, losses=None,
+                    mesh_used=None, resharded=None):
+    """Worker fn of the drill: plan resolved from config, re-resolved
+    on the surviving pool, mesh built on exactly those devices, restore
+    reshards — the same shape both ray-jobs entries implement."""
+    cfg = _cfg()
+    opt = make_optimizer(1e-3)
+
+    def worker(config):
+        plan, devs = maybe_replan(ExecutionPlan.resolve(config),
+                                  config=config)
+        if mesh_used is not None:
+            mesh_used.append(len(devs))
+        mesh = plan.build_mesh(devs)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step_fn = make_train_step(cfg, opt, mesh=mesh, donate=False)
+        mgr = CheckpointManager(ckpt_dir, max_to_keep=2,
+                                score_attribute=None, async_save=False)
+        inj = None
+        if fault_spec:
+            inj = FaultInjector(parse_fault_spec(fault_spec), rank=0,
+                                ckpt_manager=mgr)
+
+        def recording_step(st, batch):
+            st2, m = step_fn(st, batch)
+            if losses is not None:
+                step = int(jax.device_get(st.step)) + 1
+                losses[step] = float(jax.device_get(m["loss"]))
+            return st2, m
+
+        try:
+            final, metrics = run_training(
+                state, recording_step, _batches, epochs=1,
+                ckpt_manager=mgr, ckpt_every=2,
+                place_batch=make_place_batch(mesh), fault_injector=inj)
+        finally:
+            if resharded is not None:
+                resharded.append(mgr.last_restore_resharded)
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **{
+            k: v for k, v in metrics.items() if isinstance(v, float)}}
+    return worker
+
+
+def _drill_config():
+    return {"MESH_DATA": 1, "MESH_FSDP": -1,
+            "PER_DEVICE_TRAIN_BATCH_SIZE": 1, "MAX_SEQ_LENGTH": S,
+            "TOPOLOGY": "cpu-8", "ELASTIC": "1"}
+
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    """ONE 8→4→8 drill through the real retry loop, shared by the
+    assertions below (it is the expensive part: five compiles across
+    two mesh shapes). State hygiene is done inline — the module-scoped
+    fixture cannot use the function-scoped autouse cleaner."""
+    root = tmp_path_factory.mktemp("elastic_drill")
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    try:
+        ref_losses = {}
+        ref = JaxTrainer(
+            _elastic_worker(str(root / "ref"), losses=ref_losses),
+            train_loop_config=_drill_config(), use_ray=False).fit()
+        losses, mesh_used, resharded = {}, [], []
+        res = JaxTrainer(
+            _elastic_worker(
+                str(root / "elastic"),
+                fault_spec=(
+                    f"rank=0:kind=pool_shrink:to=4:step={SHRINK_AT};"
+                    f"rank=0:kind=pool_shrink:to=8:step={GROW_AT}"),
+                losses=losses, mesh_used=mesh_used, resharded=resharded),
+            train_loop_config=_drill_config(), use_ray=False,
+            run_config=RunConfig(failure_config=FailureConfig(
+                max_failures=0, max_preemptions=4))).fit()
+    finally:
+        reset_fired()
+        reset_pool()
+        preempt.reset()
+        preempt.uninstall()
+        os.environ.pop("ELASTIC_N_DEVICES", None)
+    return dict(ref=ref, ref_losses=ref_losses, res=res, losses=losses,
+                mesh_used=mesh_used, resharded=resharded)
+
+
+def test_elastic_drill_shrink_resume_grow_recover(drill):
+    ref, res = drill["ref"], drill["res"]
+    assert ref.error is None and ref.metrics["final_step"] == STEPS
+    # no human intervention, no failure-budget burn (max_failures=0):
+    # both pool changes were classified as preemptions
+    assert res.error is None and res.status == "ok"
+    assert res.attempts == 3 and res.preemptions == 2
+    assert res.metrics["final_step"] == STEPS
+    assert drill["mesh_used"] == [8, 4, 8]
+    # the restore path RESHARDED both times (8->4, then 4->8)
+    assert drill["resharded"] == [None, (8, 4), (4, 8)]
+
+    shrink, grow, ok = res.attempt_log
+    assert shrink["status"] == "preempted" and shrink["event"] == "shrink"
+    assert shrink["pool"] == 4 and shrink["step"] == SHRINK_AT
+    assert grow["status"] == "preempted" and grow["event"] == "grow"
+    assert grow["pool"] == 8 and grow["resumed_step"] == SHRINK_AT
+    assert ok["status"] == "ok" and ok["resumed_step"] == GROW_AT
+    # each attempt ran under its own plan: the shrunken attempt's
+    # fingerprint differs, and recovery returns to the declared plan
+    assert grow["plan_fingerprint"] != shrink["plan_fingerprint"]
+    assert ok["plan_fingerprint"] == shrink["plan_fingerprint"]
+
+
+def test_elastic_drill_loss_trajectory_continuous(drill):
+    # loss-trajectory continuity across BOTH reshards: same stream,
+    # same global batch (preserved by replan), same states — only the
+    # reduction layout differs (float tolerance, not bitwise)
+    losses, ref_losses = drill["losses"], drill["ref_losses"]
+    assert sorted(losses) == sorted(ref_losses)
+    for step in ref_losses:
+        assert losses[step] == pytest.approx(ref_losses[step],
+                                             rel=1e-3, abs=1e-4), step
+
+
+def test_elastic_drill_ledger_reconciles(drill):
+    res = drill["res"]
+    for entry in res.attempt_log:
+        g = entry["goodput"]
+        assert set(LEDGER_TERMS) <= set(g)
+        # reconciliation: terms sum to the attempt wall-clock
+        assert sum(g[t] for t in LEDGER_TERMS) == \
+            pytest.approx(g["wall_s"], abs=1e-6)
+        assert g["compile_s"] > 0 and g["step_s"] > 0
+    # the resumed attempts actually paid a restore
+    assert res.attempt_log[1]["goodput"]["restore_s"] > 0
+    assert res.attempt_log[2]["goodput"]["restore_s"] > 0
+    # the summed ledger reconciles too, and the headline is a fraction
+    total = res.goodput
+    assert total["wall_s"] == pytest.approx(
+        sum(e["goodput"]["wall_s"] for e in res.attempt_log))
+    assert 0.0 < total["goodput_frac"] <= 1.0
+
+
+def test_slice_evict_is_shrink_not_failure(tmp_path, monkeypatch):
+    # a REAL two-slice layout: the data axis spans the slices (the
+    # hybrid-mesh contract), and the eviction removes one whole slice
+    monkeypatch.setenv("NUM_SLICES", "2")
+    config = dict(_drill_config(), MESH_DATA=2, NUM_SLICES=2)
+    mesh_used = []
+    res = JaxTrainer(
+        _elastic_worker(
+            str(tmp_path / "evict"),
+            fault_spec=f"rank=0:kind=slice_evict:step={SHRINK_AT}",
+            mesh_used=mesh_used),
+        train_loop_config=config, use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=0, max_preemptions=2))).fit()
+    # max_failures=0 survived: the eviction burned the preemption
+    # budget, not the failure budget
+    assert res.error is None
+    assert res.preemptions == 1 and res.attempts == 2
+    assert res.attempt_log[0]["event"] == "shrink"
+    assert res.attempt_log[0]["pool"] == 4
+    assert mesh_used == [8, 4]
+    assert res.metrics["final_step"] == STEPS
+
+
+def test_min_devices_floor_fails_loudly(tmp_path):
+    config = dict(_drill_config(), MIN_DEVICES=8)
+    res = JaxTrainer(
+        _elastic_worker(
+            str(tmp_path / "floor"),
+            fault_spec=f"rank=0:kind=pool_shrink:to=4:step={SHRINK_AT}"),
+        train_loop_config=config, use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=2, max_preemptions=4))).fit()
+    assert res.status == "failed"
+    assert "MIN_DEVICES" in res.error
+
+
+def test_elastic_off_keeps_legacy_behavior(tmp_path):
+    """Without ELASTIC, a pool-change notice is a plain preemption: the
+    retry comes back on the ORIGINAL topology (today's wait-for-
+    identical-hardware semantics) and no event is recorded."""
+    config = {k: v for k, v in _drill_config().items() if k != "ELASTIC"}
+    mesh_used = []
+    res = JaxTrainer(
+        _elastic_worker(
+            str(tmp_path / "off"),
+            fault_spec=f"rank=0:kind=pool_shrink:to=4:step={SHRINK_AT}",
+            mesh_used=mesh_used),
+        train_loop_config=config, use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=0, max_preemptions=2))).fit()
+    assert res.error is None and res.preemptions == 1
+    assert mesh_used == [8, 8]
+    assert "event" not in res.attempt_log[0]
+
+
+# ---------------------------------------------------------------------
+# worker-side helpers + ckpt topology witness
+# ---------------------------------------------------------------------
+
+def test_elastic_devices_honors_pool_env(devices, monkeypatch):
+    assert elastic_devices(devices) == list(devices)
+    monkeypatch.setenv("ELASTIC_N_DEVICES", "4")
+    assert elastic_devices(devices) == list(devices[:4])
+    monkeypatch.setenv("ELASTIC_N_DEVICES", "16")   # >= pool: full
+    assert elastic_devices(devices) == list(devices)
+    monkeypatch.setenv("ELASTIC_N_DEVICES", "junk")
+    assert elastic_devices(devices) == list(devices)
+
+
+def test_elastic_knob_resolution(monkeypatch):
+    assert not elastic_enabled({})
+    assert elastic_enabled({"ELASTIC": "1"})
+    monkeypatch.setenv("ELASTIC", "true")
+    assert elastic_enabled()
+    assert min_devices({"MIN_DEVICES": 4}) == 4
+    monkeypatch.setenv("MIN_DEVICES", "2")
+    assert min_devices() == 2
+    assert min_devices({"MIN_DEVICES": "bogus"}) == 1
+
+
+def test_run_config_elastic_reaches_worker_env(devices, monkeypatch):
+    """RunConfig(elastic=True) must arm the WORKER-side gate too —
+    rayint/elastic.py reads config/env only, so the trainer forwards
+    ELASTIC alongside the pool override."""
+    t = JaxTrainer(lambda c: {}, use_ray=False,
+                   run_config=RunConfig(elastic=True))
+    t._pool_override = 4
+    env = t._pool_env()
+    assert env == {"ELASTIC": "1", "ELASTIC_N_DEVICES": "4"}
+    # the forwarded pair satisfies maybe_replan's gate with NO config
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    plan = ExecutionPlan.from_kwargs(data=1, fsdp=-1, topology="cpu-8")
+    new, devs = maybe_replan(plan, devices, config={})
+    assert new.chips == 4 and len(devs) == 4
+    # without the override armed, no pool env leaks
+    t2 = JaxTrainer(lambda c: {}, use_ray=False,
+                    run_config=RunConfig(elastic=True))
+    assert t2._pool_env() == {"ELASTIC": "1"}
+
+
+def test_maybe_replan_noop_without_elastic(devices, monkeypatch):
+    plan = ExecutionPlan.from_kwargs(data=1, fsdp=-1, topology="cpu-8")
+    monkeypatch.setenv("ELASTIC_N_DEVICES", "4")
+    # pool shrunken but elasticity off: plan untouched, pool truncated
+    same, devs = maybe_replan(plan, devices, config={})
+    assert same is plan and len(devs) == 4
+    new, devs = maybe_replan(plan, devices, config={"ELASTIC": "1"})
+    assert new.chips == 4 and new.resolved_sizes()["fsdp"] == 4
+
+
+def test_ckpt_topology_note_and_reshard_witness(tmp_path, devices):
+    from gke_ray_train_tpu.models.transformer import init_params, param_specs
+    from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
+    from gke_ray_train_tpu.parallel.sharding import shard_tree
+
+    cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+               d_ff=128, vocab_size=256)
+    save_mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices)
+    params = shard_tree(init_params(cfg, jax.random.key(0)), save_mesh,
+                        param_specs(cfg))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=1,
+                            score_attribute=None, async_save=False)
+    mgr.save(3, params, force=True)
+    mgr.wait()
+    assert mgr.saved_topology() == {"step": 3, "n_devices": 8}
+
+    # restore template on HALF the pool: the witness records 8 -> 4
+    small_mesh = build_mesh(MeshConfig(data=1, fsdp=4), devices[:4])
+    template = shard_tree(init_params(cfg, jax.random.key(1)),
+                          small_mesh, param_specs(cfg))
+    out, step = mgr.restore_if_available(template)
+    assert step == 3
+    assert mgr.last_restore_resharded == (8, 4)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # same-topology restore leaves no reshard witness
+    out2, _ = mgr.restore_if_available(params)
+    assert mgr.last_restore_resharded is None
+    mgr.close()

@@ -402,7 +402,16 @@ def test_result_attempt_metadata_on_clean_run():
     res = JaxTrainer(lambda c: {"x": 1}, use_ray=False).fit()
     assert res.status == "ok"
     assert res.attempts == 1 and res.preemptions == 0
-    assert res.attempt_log == [{"status": "ok", "resumed_step": None}]
+    assert len(res.attempt_log) == 1
+    entry = res.attempt_log[0]
+    assert entry["status"] == "ok" and entry["resumed_step"] is None
+    # every attempt carries its goodput ledger (train/metrics.py), and
+    # the terms reconcile to the attempt wall-clock by construction
+    from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+    g = entry["goodput"]
+    assert set(LEDGER_TERMS) <= set(g)
+    assert abs(sum(g[t] for t in LEDGER_TERMS) - g["wall_s"]) < 1e-6
+    assert res.goodput["wall_s"] == g["wall_s"]
 
 
 # ---- multi-process drill (tests/_multihost.py path) ------------------

@@ -331,8 +331,23 @@ def test_serve_shape_splits_compile_fingerprint():
     a = ExecutionPlan.from_kwargs()
     b = ExecutionPlan.from_kwargs(max_batch=16)
     c = ExecutionPlan.from_kwargs(prefetch=7)   # operational knob
-    assert a.compile_fingerprint() != b.compile_fingerprint()
-    assert a.compile_fingerprint() == c.compile_fingerprint()
+    # serve-shape fields split the SERVE surface (engine sidecars and
+    # replica cache dirs stale) ...
+    assert a.compile_fingerprint("serve") != b.compile_fingerprint("serve")
+    assert a.compile_fingerprint("serve") == c.compile_fingerprint("serve")
+    # ... but no longer churn the TRAIN surface (the PR 7 tradeoff,
+    # removed by per-surface fingerprints): a serving retune must not
+    # invalidate the training job's AOT sidecar
+    assert a.compile_fingerprint("train") == b.compile_fingerprint("train")
+    assert a.compile_fingerprint("train") == c.compile_fingerprint("train")
+    # train-shape fields split train and leave serve alone, symmetric
+    d = ExecutionPlan.from_kwargs(grad_accum=2)
+    assert a.compile_fingerprint("train") != d.compile_fingerprint("train")
+    assert a.compile_fingerprint("serve") == d.compile_fingerprint("serve")
+    # mesh fields shape BOTH surfaces
+    e = ExecutionPlan.from_kwargs(model=2, fsdp=4, topology="cpu-8")
+    assert a.compile_fingerprint("train") != e.compile_fingerprint("train")
+    assert a.compile_fingerprint("serve") != e.compile_fingerprint("serve")
 
 
 def test_post_train_smoke_runs_and_degrades(setup, caplog):
