@@ -18,6 +18,11 @@ A3/H100 + NCCL), rebuilt TPU-first:
 
 __version__ = "0.1.0"
 
+# Sharding-invariant init is a correctness contract here (meshed init ==
+# plain init == init on any elastic topology): every init path wraps
+# itself in parallel.sharding.sharding_invariant_rng (partitionable
+# threefry, scoped — the global flag costs ~15% wall on CPU suites).
+
 from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     build_mesh,

@@ -1,4 +1,4 @@
-"""shardlint — three-level sharding & host-sync static analysis.
+"""shardlint — five-level sharding, host-sync & kernel analysis.
 
 Level 1 (:mod:`analysis.astlint`): AST rules TPU001–TPU005 over the
 repo's own source — host-syncs in jit-reachable code, PartitionSpec
@@ -21,7 +21,15 @@ pure shape arithmetic + ``jax.eval_shape``, the checkpoint-portability
 matrix across fake-device topologies, and cross-artifact consistency
 (budget fingerprints, KNOWN_KEYS drift). No backend, no hardware.
 
-CLI: ``python -m gke_ray_train_tpu.analysis lint|trace|check|plancheck``.
+Level 5 (:mod:`analysis.kernelcheck` over :mod:`ops.registry`):
+kernel verification — static grid/VMEM/mesh-contract rules and a jaxpr
+numerics lint (KER001–006, recursing into ``pallas_call`` bodies),
+plus registry-driven differential value+grad sweeps of every
+accelerated op against its reference oracle, gated by the checked-in
+tolerance ledger (``tests/tolerances/*.json``, two-sided: KER100–102).
+
+CLI: ``python -m gke_ray_train_tpu.analysis
+lint|trace|check|plancheck|kernelcheck``.
 """
 
 from gke_ray_train_tpu.analysis.astlint import (  # noqa: F401
@@ -36,3 +44,7 @@ from gke_ray_train_tpu.analysis.guards import (  # noqa: F401
 from gke_ray_train_tpu.analysis.plancheck import (  # noqa: F401
     PlanFinding, check_config, check_config_file, check_paths,
     drift_findings, feasibility_findings, portability_findings)
+from gke_ray_train_tpu.analysis.kernelcheck import (  # noqa: F401
+    CaseResult, KernelCheckError, KernelFinding, kernel_constraint_findings,
+    ledger_findings, lint_traced_fn, numerics_findings, quick_verify,
+    run_case, sweep)

@@ -8,13 +8,21 @@
               topology feasibility, model-dim divisibility, the
               checkpoint-portability matrix, budget/fingerprint
               consistency, KNOWN_KEYS drift; exit 1 on findings.
+``kernelcheck`` level-5 kernel verification (kernelcheck.py): static
+              grid/VMEM/mesh-contract rules + the jaxpr numerics lint
+              (KER001-006), then registry-driven differential sweeps
+              of every accelerated op against its oracle vs the pinned
+              tolerance ledger (KER100-102); exit 1 on findings.
+              ``--record`` / ``TOLERANCE_UPDATE=1`` re-records the
+              ledger, ``--static-only`` skips the sweeps.
 
 ``trace``/``check`` need the canonical 8-fake-device CPU mesh, so —
 like ``perf.budget`` — they re-exec themselves into a child with the
-forced-CPU env when not already on it. ``lint`` is pure AST and runs
-anywhere; ``plancheck`` is pure shape arithmetic + ``jax.eval_shape``
-(no backend, no devices — it never probes the possibly-dead
-accelerator), so both run on the CI lint runner.
+forced-CPU env when not already on it; ``kernelcheck``'s differential
+sweeps do the same (its static half runs anywhere). ``lint`` is pure
+AST and runs anywhere; ``plancheck`` is pure shape arithmetic +
+``jax.eval_shape`` (no backend, no devices — it never probes the
+possibly-dead accelerator), so both run on the CI lint runner.
 """
 
 from __future__ import annotations
@@ -90,6 +98,15 @@ def _reexec_on_cpu_mesh(argv: List[str]) -> int:
     ).returncode
 
 
+def _kernelcheck(args) -> int:
+    from gke_ray_train_tpu.analysis.kernelcheck import main_check
+    return main_check(
+        args.names or None, static_only=args.static_only,
+        diff_only=args.diff_only, record=args.record,
+        ledger_dir=args.ledger_dir,
+        config_paths=args.configs or None)
+
+
 def _on_canonical_mesh() -> bool:
     import jax
     return jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8
@@ -151,15 +168,46 @@ def main(argv: Optional[List[str]] = None) -> int:
                              "ray-jobs/fine_tune_config*.json presets)")
     p_plan.add_argument("--budget-dir", default=None,
                         help="budget directory (default tests/budgets)")
+    p_ker = sub.add_parser(
+        "kernelcheck",
+        help="level 5: static kernel rules (KER001-006) + differential "
+             "kernel-vs-oracle sweeps against the tolerance ledger "
+             "(KER100-102)")
+    p_ker.add_argument("names", nargs="*",
+                       help="registered kernels (default: all)")
+    p_ker.add_argument("--static-only", action="store_true",
+                       help="KER001-006 only (no devices needed)")
+    p_ker.add_argument("--diff-only", action="store_true",
+                       help="differential sweeps only")
+    p_ker.add_argument("--record", action="store_true",
+                       help="re-record tests/tolerances/*.json "
+                            "(same as TOLERANCE_UPDATE=1)")
+    p_ker.add_argument("--ledger-dir", default=None,
+                       help="tolerance directory (default "
+                            "tests/tolerances)")
+    p_ker.add_argument("--configs", nargs="*", default=None,
+                       help="config JSONs for the static rules "
+                            "(default: the shipped presets)")
     args = parser.parse_args(argv)
 
     if args.command == "lint":
         return _lint(args.paths)
     if args.command == "plancheck":
         return _plancheck(args.configs, args.budget_dir)
+    if args.command == "kernelcheck" and args.static_only:
+        return _kernelcheck(args)   # pure arithmetic + jaxpr tracing
     if os.environ.get("_ANALYSIS_CLI_NATIVE") != "1" \
             and not _on_canonical_mesh():
-        return _reexec_on_cpu_mesh([args.command] + args.names)
+        argv_out = [args.command] + args.names
+        if args.command == "kernelcheck":
+            argv_out += (["--diff-only"] if args.diff_only else []) \
+                + (["--record"] if args.record else []) \
+                + (["--ledger-dir", args.ledger_dir]
+                   if args.ledger_dir else []) \
+                + (["--configs"] + args.configs if args.configs else [])
+        return _reexec_on_cpu_mesh(argv_out)
+    if args.command == "kernelcheck":
+        return _kernelcheck(args)
     return _trace(args.names) if args.command == "trace" \
         else _check(args.names)
 

@@ -85,6 +85,12 @@ KNOWN_KEYS = frozenset({
     # Trainer-scoped (like SERVE_AFTER_TRAIN), not plan-scoped: they
     # change retry policy, never the compiled program.
     "ELASTIC", "MIN_DEVICES",
+    # kernelcheck (analysis/kernelcheck.py): KERNELCHECK=1 runs the
+    # registry's differential startup probe in every worker (each
+    # kernel's cheapest case vs its oracle, gated by the pinned
+    # ledger); TOLERANCE_UPDATE=1 re-records tests/tolerances/*.json.
+    # Trainer/CLI-scoped: neither changes the compiled program.
+    "KERNELCHECK", "TOLERANCE_UPDATE",
     # TPU / model-numerics extensions (the plan owns the mesh keys)
     "TRAIN_DTYPE", "PARAM_DTYPE", "ATTN_IMPL", "REMAT_POLICY",
     "SMOKE_TEST",

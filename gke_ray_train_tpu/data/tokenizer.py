@@ -85,8 +85,13 @@ class ByteTokenizer:
         return self(text)["input_ids"]
 
     def decode(self, ids) -> str:
+        # ids outside [len(_RESERVED), 256 + len(_RESERVED)) are skipped
+        # like reserved ids: a model whose vocab is padded past the byte
+        # range (e.g. to a sharding-divisible size) can legitimately
+        # emit them while untrained, and decode must degrade like
+        # errors="replace" does — not crash the inference comparison
         bs = bytes(int(i) - len(_RESERVED) for i in ids
-                   if int(i) >= len(_RESERVED))
+                   if len(_RESERVED) <= int(i) < 256 + len(_RESERVED))
         return bs.decode("utf-8", errors="replace")
 
 

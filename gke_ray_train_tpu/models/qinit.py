@@ -56,6 +56,18 @@ def init_quantized_params(cfg: ModelConfig, key: jax.Array, *,
                           kind: str = "nf4", group: int = DEFAULT_GROUP,
                           mesh: Optional[Mesh] = None,
                           targets=QUANT_TARGETS) -> Params:
+    """Sharding-invariant entry: same draws meshed or not (see
+    parallel.sharding.sharding_invariant_rng and make_train_state)."""
+    from gke_ray_train_tpu.parallel.sharding import sharding_invariant_rng
+    with sharding_invariant_rng():
+        return _init_quantized_params(cfg, key, kind=kind, group=group,
+                                      mesh=mesh, targets=targets)
+
+
+def _init_quantized_params(cfg: ModelConfig, key: jax.Array, *,
+                           kind: str = "nf4", group: int = DEFAULT_GROUP,
+                           mesh: Optional[Mesh] = None,
+                           targets=QUANT_TARGETS) -> Params:
     """init_params with the targeted projections quantized as they are
     created. Same tree structure, same init distribution (truncated
     normal, 1/sqrt(2*n_layers) residual-writer scaling), same sharding

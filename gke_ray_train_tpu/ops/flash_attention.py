@@ -111,6 +111,28 @@ def _block_live(q_pos, kv_pos, q_seg, kv_seg, causal, window):
 FULL_BLOCK_LIMIT = 2048  # max seq to load as one VMEM block
 
 
+def estimate_vmem_bytes(block_q: int, block_kv: int, head_dim: int,
+                        dtype_bytes: int) -> int:
+    """Static VMEM footprint of one fwd-kernel grid step — the number
+    kernelcheck's KER002 compares against the chip's per-core budget.
+
+    Counts the I/O blocks the BlockSpecs DMA (q, k, v, o, lse, plus the
+    int32 position/segment vectors) double-buffered — Pallas pipelines
+    the next grid step's DMA against this step's compute — and the fp32
+    scratch (acc + the [block_q, 128] m/l accumulators). An estimate,
+    not Mosaic's allocator: it exists to catch order-of-magnitude
+    misconfiguration (FLASH_BLOCK_KV=32768) in lint, not to pack VMEM.
+    """
+    io = (block_q * head_dim * dtype_bytes            # q block
+          + 2 * block_kv * head_dim * dtype_bytes     # k, v blocks
+          + block_q * head_dim * dtype_bytes          # o block
+          + block_q * 4                               # lse row (fp32)
+          + 2 * (block_q + block_kv) * 4)             # pos/seg (int32)
+    scratch = (block_q * head_dim * 4                 # acc (fp32)
+               + 2 * block_q * 128 * 4)               # m, l (fp32)
+    return 2 * io + scratch
+
+
 def pick_block(requested: int, n: int) -> int:
     """A block size that tiles n exactly and satisfies Mosaic tiling.
 

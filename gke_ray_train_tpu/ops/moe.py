@@ -100,17 +100,30 @@ def moe_mlp(x: jnp.ndarray, router_w: jnp.ndarray, w_gate: jnp.ndarray,
     # when the expert bank is a quantized QLoRA base)
     from gke_ray_train_tpu.ops.quant import maybe_dequantize
 
+    # every dispatch/expert einsum declares fp32 accumulation and
+    # rounds ONCE on the way out (kernelcheck KER005: a bf16
+    # dot_general without preferred_element_type accumulates — and
+    # rounds — the whole contraction in bf16). The big [B, S, E, C]
+    # combine/dispatch tensors stay in the compute dtype (the VERDICT
+    # r4 memory fix); only the transient einsum results ride fp32.
+    f32 = jnp.float32
     dispatch = (combine > 0).astype(dtype)             # [B, S, E, C]
-    xin = jnp.einsum("bsec,bsd->ebcd", dispatch,
-                     x.astype(dtype))                  # [E, B, C, D]
-    gate = jnp.einsum("ebcd,edf->ebcf", xin, maybe_dequantize(w_gate, dtype))
-    up = jnp.einsum("ebcd,edf->ebcf", xin, maybe_dequantize(w_up, dtype))
+    xin = jnp.einsum("bsec,bsd->ebcd", dispatch, x.astype(dtype),
+                     preferred_element_type=f32).astype(dtype)
+    gate = jnp.einsum("ebcd,edf->ebcf", xin,
+                      maybe_dequantize(w_gate, dtype),
+                      preferred_element_type=f32).astype(dtype)
+    up = jnp.einsum("ebcd,edf->ebcf", xin, maybe_dequantize(w_up, dtype),
+                    preferred_element_type=f32).astype(dtype)
     if cfg.activation == "silu":
         act = jax.nn.silu(gate)
     elif cfg.activation == "gelu_tanh":
         act = jax.nn.gelu(gate, approximate=True)
     else:
         raise ValueError(f"unknown activation {cfg.activation}")
-    h = jnp.einsum("ebcf,efd->ebcd", act * up, maybe_dequantize(w_down, dtype))
-    y = jnp.einsum("bsec,ebcd->bsd", combine, h)
+    h = jnp.einsum("ebcf,efd->ebcd", act * up,
+                   maybe_dequantize(w_down, dtype),
+                   preferred_element_type=f32).astype(dtype)
+    y = jnp.einsum("bsec,ebcd->bsd", combine, h,
+                   preferred_element_type=f32)
     return y.astype(dtype), aux

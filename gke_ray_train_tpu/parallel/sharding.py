@@ -8,10 +8,33 @@ declarative: every param pytree travels with a matching pytree of
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@contextlib.contextmanager
+def sharding_invariant_rng():
+    """Partitionable threefry for the duration: random draws made inside
+    are IDENTICAL however — and whether — their outputs are sharded.
+
+    On jaxlib 0.4.x the default (non-partitionable) threefry makes a
+    jitted draw's VALUES depend on its ``out_shardings`` (kernelcheck's
+    differential sweeps caught meshed ``init_params`` diverging from
+    the plain oracle by ~3 init-stds). Every init path wraps itself in
+    this context, making meshed init == plain init == init on ANY
+    topology (the elastic same-seed-any-pool contract, PR 8) a real
+    invariant. Scoped rather than set globally: partitionable
+    generation costs ~15% wall on CPU-heavy suites, and init is where
+    sharding-invariance is a *correctness* contract."""
+    old = bool(jax.config.jax_threefry_partitionable)
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_threefry_partitionable", old)
 
 
 def tree_shardings(mesh: Mesh, spec_tree: Any) -> Any:

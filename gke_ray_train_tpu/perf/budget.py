@@ -33,7 +33,12 @@ from typing import Any, Dict, List, Optional, Union
 from gke_ray_train_tpu.perf.costs import (
     COLLECTIVE_KINDS, StepCostReport, step_cost_report)
 
-# two-sided relative tolerances; collective COUNTS are exact by design
+# two-sided relative tolerances; collective COUNTS are exact by design.
+# exposed_collective_bytes / overlap_frac are the overlap-analysis
+# fields (perf.costs.overlap_stats): a pinned 0 stays exactly 0 under
+# relative tolerances, so the first collective a schedule EXPOSES (or
+# the first one it newly hides) is a budget event, not drift noise —
+# the asserted metric the ROADMAP #3 overlap work moves.
 DEFAULT_TOLERANCES = {
     "flops": 0.05,
     "bytes_accessed": 0.25,
@@ -41,6 +46,8 @@ DEFAULT_TOLERANCES = {
     "argument_bytes": 0.05,
     "output_bytes": 0.05,
     "collective_bytes": 0.25,
+    "exposed_collective_bytes": 0.25,
+    "overlap_frac": 0.05,
 }
 
 BUDGET_DIR = os.path.join(
@@ -106,6 +113,7 @@ def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
     tol.update(budget.get("tolerances", {}))
     tol.update(tolerances or {})
     viols: List[str] = []
+    overlap_tripped = False
     for field, t in tol.items():
         if field not in budget or field not in report:
             continue
@@ -116,6 +124,13 @@ def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
                 f"{field}: {have:.4g} vs budget {want:.4g} "
                 f"({'+' if have > want else '-'}{d:.1%}, tolerance "
                 f"{t:.0%})")
+            if field in ("exposed_collective_bytes", "overlap_frac"):
+                overlap_tripped = True
+    if overlap_tripped:
+        # the offending schedule region: which collectives changed
+        # exposure state (hidden <-> EXPOSED) or appeared/vanished
+        viols.extend(_hlo_delta(report.get("exposure_lines", []),
+                                budget.get("exposure_lines", [])))
 
     want_counts = budget.get("collective_counts")
     if want_counts is not None:

@@ -31,6 +31,7 @@ class ChipSpec:
     peak_flops: float        # dense bf16 FLOP/s per chip
     hbm_bytes_per_s: float   # HBM bandwidth per chip
     hbm_bytes: float         # HBM capacity per chip
+    vmem_bytes: float = 16 * 2**20   # on-chip vector memory per core
 
 
 CHIP_SPECS = {
@@ -38,7 +39,8 @@ CHIP_SPECS = {
     "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 * 2**30),
     "v4": ChipSpec("v4", 275e12, 1228e9, 32 * 2**30),
     "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 * 2**30),
-    # nominal CPU spec: keeps ceilings finite for the CI mesh
+    # nominal CPU spec: keeps ceilings finite for the CI mesh; vmem uses
+    # the TPU figure so kernelcheck KER002 verdicts match real chips
     "cpu": ChipSpec("cpu", 1e12, 50e9, 8 * 2**30),
 }
 
@@ -100,6 +102,161 @@ def collective_stats(hlo_text: str) -> Tuple[Dict[str, int], int, List[str]]:
     return counts, total_bytes, lines
 
 
+# ---------------------------------------------------------------------------
+# overlap / exposure analysis of the scheduled entry computation
+# ---------------------------------------------------------------------------
+
+_ENTRY_OP_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(.+)$")
+_COMPUTE_KINDS = ("dot", "convolution", "fusion", "custom-call")
+_COMPUTE_RE = re.compile(
+    r"^(.*?)\s(" + "|".join(_COMPUTE_KINDS) + r")\(")
+
+
+def _computations(hlo_text: str) -> List[List[Tuple[str, str]]]:
+    """Per-computation [(name, rhs)] op lists, in schedule order (the
+    optimized module prints each computation's ops in the order the
+    scheduler chose). Collectives live in the ENTRY computation AND in
+    loop bodies (a scanned grad-accum step keeps its collectives inside
+    the while body), so exposure is analyzed per computation."""
+    comps: List[List[Tuple[str, str]]] = []
+    cur: Optional[List[Tuple[str, str]]] = None
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        if cur is None:
+            # "%comp (args) -> type {" or "ENTRY %main (...) -> ... {"
+            if stripped.endswith("{") and ("->" in stripped
+                                           or stripped.startswith("ENTRY")):
+                cur = []
+            continue
+        if stripped == "}" or line.startswith("}"):
+            comps.append(cur)
+            cur = None
+            continue
+        m = _ENTRY_OP_RE.match(line)
+        if m:
+            cur.append((m.group(1), m.group(2)))
+    if cur:
+        comps.append(cur)
+    return comps
+
+
+def overlap_stats(hlo_text: str) -> Tuple[int, float, List[str]]:
+    """(exposed_collective_bytes, overlap_frac, attribution lines).
+
+    Walks the scheduled ENTRY computation and classifies every
+    collective as *hidden* (async ``-start``/``-done`` pair with
+    independent compute scheduled inside the window) or *EXPOSED*
+    (synchronous form, or an async pair whose window is empty — the
+    step stalls for the full fabric latency). For each collective the
+    attribution line also reports the independent compute — ops that
+    are neither ancestors nor descendants of the collective — i.e. the
+    work a latency-hiding schedule COULD move into its window. That
+    number is the actionable half: ``exposed > 0`` with independent
+    compute available is exactly the overlap opportunity ROADMAP #3
+    asserts through budgets.
+
+    ``overlap_frac`` = hidden bytes / total collective bytes (1.0 when
+    the program has no collectives — nothing is exposed)."""
+    exposed = 0
+    total = 0
+    lines: List[str] = []
+    for ops in _computations(hlo_text):
+        e, t, ls = _overlap_in_computation(ops)
+        exposed += e
+        total += t
+        lines.extend(ls)
+    frac = 1.0 if total == 0 else round(1.0 - exposed / total, 6)
+    return exposed, frac, lines
+
+
+def _overlap_in_computation(ops: List[Tuple[str, str]]
+                            ) -> Tuple[int, int, List[str]]:
+    index = {name: i for i, (name, _) in enumerate(ops)}
+    deps: Dict[str, List[str]] = {}
+    users: Dict[str, List[str]] = {n: [] for n, _ in ops}
+    for name, rhs in ops:
+        paren = rhs.find("(")
+        body = rhs[paren:] if paren >= 0 else rhs
+        deps[name] = [d for d in re.findall(r"%([\w.\-]+)", body)
+                      if d in index and d != name]
+        for d in deps[name]:
+            users[d].append(name)
+
+    def reach(name: str, edges: Dict[str, List[str]]) -> set:
+        """Transitive closure from ONE op — two walks per collective
+        (ancestors via deps, descendants via users) keep the whole
+        analysis O(#collectives x E) instead of materializing a
+        closure per op (a non-tiny step module has 10^4+ ops and this
+        runs inside every step_cost_report)."""
+        out: set = set()
+        stack = list(edges.get(name, ()))
+        while stack:
+            d = stack.pop()
+            if d in out:
+                continue
+            out.add(d)
+            stack.extend(edges.get(d, ()))
+        return out
+
+    compute: Dict[str, int] = {}       # name -> result bytes
+    for name, rhs in ops:
+        m = _COMPUTE_RE.match(rhs)
+        if m:
+            compute[name] = _shape_bytes(m.group(1))
+
+    # collect collectives: sync ops, and start/done pairs (done's first
+    # operand chain leads back to the start op)
+    total = 0
+    exposed = 0
+    lines: List[str] = []
+    done_for: Dict[str, Tuple[str, str]] = {}
+    rhs_of = dict(ops)
+    for name, rhs in ops:
+        m = re.search(r"\b(" + "|".join(COLLECTIVE_KINDS) + r")-done\(",
+                      rhs)
+        if m:
+            starts = [d for d in deps[name] if f"{m.group(1)}-start(" in
+                      rhs_of.get(d, "")]
+            if starts:
+                done_for[starts[0]] = (name, rhs)
+    for name, rhs in ops:
+        m = _COLL_RE.search("= " + rhs if not rhs.startswith("=") else rhs)
+        if m is None:
+            continue
+        kind = m.group(2)
+        is_start = f"{kind}-start(" in rhs
+        if is_start and name in done_for:
+            dname, drhs = done_for[name]
+            paren = drhs.find(f"{kind}-done(")
+            nbytes = _shape_bytes(drhs[:paren])
+            desc = reach(name, users)
+            window = [w for w, _ in ops[index[name] + 1:index[dname]]
+                      if w in compute and w not in desc]
+            hidden = sum(compute[w] for w in window)
+            total += nbytes
+            if hidden > 0:
+                lines.append(
+                    f"{kind} {nbytes}B hidden behind {len(window)} "
+                    f"compute op(s) (~{hidden}B results) in its "
+                    "start/done window")
+                continue
+            exposed += nbytes
+            lines.append(f"{kind} {nbytes}B EXPOSED (async pair with an "
+                         "empty window)")
+            continue
+        nbytes = _shape_bytes(m.group(1))
+        total += nbytes
+        exposed += nbytes
+        related = reach(name, deps) | reach(name, users)
+        indep = [c for c in compute if c != name and c not in related]
+        indep_bytes = sum(compute[c] for c in indep)
+        lines.append(
+            f"{kind} {nbytes}B EXPOSED (synchronous); independent "
+            f"compute available to hide it: {len(indep)} op(s) "
+            f"~{indep_bytes}B results")
+    return exposed, total, lines
+
+
 @dataclasses.dataclass
 class StepCostReport:
     """Structured per-step cost/memory ledger of one compiled program."""
@@ -115,6 +272,13 @@ class StepCostReport:
         default_factory=dict)
     collective_bytes: int = 0
     collective_lines: List[str] = dataclasses.field(default_factory=list)
+    # overlap/exposure ledger (overlap_stats): collective bytes the
+    # schedule leaves EXPOSED (no compute hides their latency), the
+    # hidden fraction, and the per-collective attribution lines — the
+    # budget fields ROADMAP #3's overlap work moves
+    exposed_collective_bytes: int = 0
+    overlap_frac: float = 1.0
+    exposure_lines: List[str] = dataclasses.field(default_factory=list)
     n_devices: int = 1
     tokens_per_step: Optional[int] = None
 
@@ -145,6 +309,7 @@ class StepCostReport:
         d = dataclasses.asdict(self)
         if not include_lines:
             d.pop("collective_lines")
+            d.pop("exposure_lines")
         return d
 
     @staticmethod
@@ -163,6 +328,8 @@ class StepCostReport:
             "collectives": {k: v for k, v in self.collective_counts.items()
                             if v},
             "collective_bytes": self.collective_bytes,
+            "exposed_collective_bytes": self.exposed_collective_bytes,
+            "overlap_frac": self.overlap_frac,
         }
         fpt = self.flops_per_token()
         if fpt is not None:
@@ -202,6 +369,10 @@ def step_cost_report(compiled, *, tokens_per_step: Optional[int] = None
     report.collective_counts = counts
     report.collective_bytes = cbytes
     report.collective_lines = lines
+    exposed, frac, exp_lines = overlap_stats(hlo)
+    report.exposed_collective_bytes = exposed
+    report.overlap_frac = frac
+    report.exposure_lines = exp_lines
     return report
 
 

@@ -251,6 +251,16 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     # initialize before distributed_init; the entry scripts re-enable
     # after it so the cache dir gains the real topology fingerprint.
     enable_persistent_cache(plan=plan)
+    # KERNELCHECK (config key wins over env, like every knob): export
+    # the resolved value so run_training's attempt-start probe sees it
+    # — the probe itself runs THERE, after distributed_init, because
+    # verifying a kernel computes and the backend must not initialize
+    # here in a multi-host worker. Scoped to the attempt (restored in
+    # the finally below): in-process fits must not inherit a previous
+    # config's setting through the process env.
+    prev_kernelcheck = os.environ.get("KERNELCHECK")
+    if "KERNELCHECK" in config:
+        os.environ["KERNELCHECK"] = str(config["KERNELCHECK"])
     ctx = get_context()
     ctx.resumed_step = None      # fresh attempt, fresh metadata
     ctx.goodput = None
@@ -294,6 +304,13 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         # nothing reads the preemption flag, and a long-lived driver
         # process must not silently swallow termination
         preempt.uninstall()
+        # the attempt-scoped KERNELCHECK export (above) must not leak
+        # into a later in-process fit whose config omits the key
+        if "KERNELCHECK" in config:
+            if prev_kernelcheck is None:
+                os.environ.pop("KERNELCHECK", None)
+            else:
+                os.environ["KERNELCHECK"] = prev_kernelcheck
 
 
 class JaxTrainer:
@@ -541,7 +558,7 @@ class JaxTrainer:
             # workers the same way (rayint/elastic.py)
             env_base.update({k: os.environ[k]
                              for k in ("ELASTIC", "MIN_DEVICES",
-                                       "NUM_SLICES")
+                                       "NUM_SLICES", "KERNELCHECK")
                              if k in os.environ})
             env_base.update(self._pool_env())
             futures = [

@@ -135,6 +135,17 @@ def run_training(state: TrainState,
     ledger = GoodputLedger()
     if guards is None:
         guards = RuntimeGuards.from_config()
+    # KERNELCHECK=1 (analysis/kernelcheck.py): before anything trains,
+    # run every registered kernel's cheapest case against its oracle,
+    # gated by the pinned tolerance ledger. Sits HERE — after
+    # distributed_init, before restore — so a kernel/oracle
+    # disagreement fails the attempt loudly instead of corrupting a
+    # run; KernelCheckError is an AssertionError, which the trainer
+    # classifies as non-retryable.
+    if os.environ.get("KERNELCHECK", "0").lower() not in (
+            "", "0", "false", "no", "off"):
+        from gke_ray_train_tpu.analysis.kernelcheck import quick_verify
+        quick_verify(log=logger.info)
     save_view = (ckpt_view[0] if ckpt_view else (lambda st: st))
     load_view = (ckpt_view[1] if ckpt_view else (lambda st, v: v))
     if fault_injector is None:

@@ -34,6 +34,12 @@ from gke_ray_train_tpu.train.lora import LoraConfig, init_lora, lora_specs
 
 Batch = Dict[str, jnp.ndarray]
 
+# trees at or under this many bytes init EAGERLY and are device_put onto
+# the mesh (bitwise-identical to the plain path, zero init-program
+# compiles); larger trees take the jitted sharded init (see
+# make_train_state's docstring)
+_EAGER_INIT_LIMIT = 256 * 2**20
+
 
 class TrainState(NamedTuple):
     params: Params
@@ -98,12 +104,38 @@ def make_train_state(cfg: ModelConfig, optimizer: optax.GradientTransformation,
 
     Optimizer state shardings are *propagated* from param shardings by
     jitting optimizer.init — mu/nu inherit the fsdp sharding, scalars
-    replicate. This is the ZeRO analogue (SURVEY.md row D5)."""
+    replicate. This is the ZeRO analogue (SURVEY.md row D5).
+
+    Meshed init is SHARDING-INVARIANT: the meshed and plain paths — and
+    any two elastic topologies — produce IDENTICAL values from the same
+    key (the pipeline/moe matches-plain oracles rely on it; on jaxlib
+    0.4.x non-partitionable threefry, a jitted draw's values otherwise
+    CHANGE with its out_shardings — the seed-failure kernelcheck's
+    sweeps ran down). Small trees init eagerly and are placed with
+    ``device_put`` — plain-path-identical by construction, and no init
+    program to compile; trees past ``_EAGER_INIT_LIMIT`` (an 8B fp32
+    init must never materialize on one host) take the jitted sharded
+    path under ``sharding_invariant_rng`` (partitionable threefry,
+    scoped — the flag's ~15% generation cost is paid only at a scale
+    where it is noise next to the init itself)."""
+    from gke_ray_train_tpu.parallel.sharding import (
+        shard_tree, sharding_invariant_rng)
+
+    def tree_bytes(shapes) -> int:
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree.leaves(shapes))
+
     if params is None:
         if mesh is not None:
-            p_shard = tree_shardings(mesh, param_specs(cfg))
-            params = jax.jit(lambda k: init_params(cfg, k),
-                             out_shardings=p_shard)(key)
+            abstract = jax.eval_shape(lambda k: init_params(cfg, k), key)
+            if tree_bytes(abstract) <= _EAGER_INIT_LIMIT:
+                params = shard_tree(init_params(cfg, key), mesh,
+                                    param_specs(cfg))
+            else:
+                with sharding_invariant_rng():
+                    p_shard = tree_shardings(mesh, param_specs(cfg))
+                    params = jax.jit(lambda k: init_params(cfg, k),
+                                     out_shardings=p_shard)(key)
         else:
             params = init_params(cfg, key)
 
@@ -111,9 +143,17 @@ def make_train_state(cfg: ModelConfig, optimizer: optax.GradientTransformation,
     if lora_cfg is not None:
         lkey = jax.random.fold_in(key, 1)
         if mesh is not None:
-            l_shard = tree_shardings(mesh, lora_specs(cfg, lora_cfg))
-            lora = jax.jit(lambda k: init_lora(cfg, lora_cfg, k),
-                           out_shardings=l_shard)(lkey)
+            abstract = jax.eval_shape(
+                lambda k: init_lora(cfg, lora_cfg, k), lkey)
+            if tree_bytes(abstract) <= _EAGER_INIT_LIMIT:
+                lora = shard_tree(init_lora(cfg, lora_cfg, lkey), mesh,
+                                  lora_specs(cfg, lora_cfg))
+            else:
+                with sharding_invariant_rng():
+                    l_shard = tree_shardings(mesh,
+                                             lora_specs(cfg, lora_cfg))
+                    lora = jax.jit(lambda k: init_lora(cfg, lora_cfg, k),
+                                   out_shardings=l_shard)(lkey)
         else:
             lora = init_lora(cfg, lora_cfg, lkey)
 

@@ -74,6 +74,14 @@ run shardlint-check python -m gke_ray_train_tpu.analysis check
 # + KNOWN_KEYS consistency. No backend needed (safe on a dead chip).
 run plancheck      python -m gke_ray_train_tpu.analysis plancheck
 
+# kernelcheck (analysis/kernelcheck.py): static kernel rules
+# (KER001-006) + differential sweeps of every registered kernel vs its
+# oracle against the pinned tolerance ledger (tests/tolerances/). The
+# sweeps re-exec onto the canonical 8-fake-device CPU mesh (safe on a
+# dead chip); only re-record the ledger (TOLERANCE_UPDATE=1) after an
+# INTENTIONAL numerics change, and review the JSON diff like code.
+run kernelcheck    python -m gke_ray_train_tpu.analysis kernelcheck
+
 # flash-kernel block-size A/B (queued since r4): 3x3 sweep around the
 # defaults on the seq4k shape where the kernel dominates (up to 8 extra
 # bench runs; the default q=256/kv=1024 cell IS the `seq4k` record

@@ -32,6 +32,14 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "float32")
 
+# NOTE: do NOT point jax_compilation_cache_dir at a suite-wide cache to
+# speed the suite up. On this jaxlib a persistent-cache HIT returns an
+# executable that (a) cannot be re-serialized into an AOT sidecar
+# (XLA:CPU "Symbols not found" — the PR 4 poisoned-sidecar issue) and
+# (b) was keyed WITHOUT the donation/aliasing spec, so a donate=True
+# build can silently receive the undonated executable. Both were caught
+# by test_perf/test_analysis when this was tried.
+
 import pytest  # noqa: E402
 
 from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: E402
