@@ -141,6 +141,19 @@ def _emit(metric, value, unit, extra, compare_baseline=True):
     except Exception as e:  # noqa: BLE001 - provenance is best-effort
         result["plan_fingerprint"] = f"unresolvable: {e}"[:80]
     print(json.dumps(result))
+    # obs sink (ISSUE 11): with OBS_DIR set, the record ALSO lands in
+    # the run's obs dir, where `python -m gke_ray_train_tpu.obs report`
+    # merges it with the events/metrics/ledger of the same run (the
+    # BENCH_MODE=elastic record beside its per-attempt event stream)
+    obs_dir = os.environ.get("OBS_DIR")
+    if obs_dir:
+        try:
+            os.makedirs(obs_dir, exist_ok=True)
+            with open(os.path.join(obs_dir, "bench_records.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(result) + "\n")
+        except OSError as e:
+            print(f"bench: obs record sink failed: {e}", file=sys.stderr)
     on_tpu = devices[0].platform != "cpu"
     if compare_baseline and baseline is None and on_tpu and \
             unit == "tokens/sec/chip":

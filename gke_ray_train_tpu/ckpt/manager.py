@@ -323,6 +323,14 @@ class CheckpointManager:
                     "devices; restored RESHARDED onto %d (shardings "
                     "re-derived from the restore template)",
                     step, int(note["n_devices"]), cur_n)
+                # obs: the resharded restore IS the reshard witness —
+                # one event per actual mesh transition (8->4 AND 4->8
+                # in the elastic drill), rendered on the attempt
+                # timeline by `obs report` (no-op when obs is off)
+                from gke_ray_train_tpu.obs import runtime as obs_runtime
+                obs_runtime.emit("reshard", step=step,
+                                 from_devices=int(note["n_devices"]),
+                                 to_devices=cur_n)
             logger.info("resuming from checkpoint step %d in %s", step,
                         self.directory)
             return out, step

@@ -48,6 +48,11 @@ PLAN_SCOPED_KEYS = frozenset({
     # serving shape (serve/engine.py): slot count, length buckets,
     # served-weight quantization
     "MAX_BATCH", "DECODE_BUCKETS", "SERVE_QUANT",
+    # observability (obs/): unified telemetry on/off + dir, and the
+    # anomaly-triggered profiler capture policy. Operational knobs —
+    # never compile-relevant (toggling telemetry must not stale a
+    # sidecar; plan.COMPILE_SURFACES excludes them).
+    "OBS", "OBS_DIR", "OBS_CAPTURE", "OBS_CAPTURE_BUDGET",
     # identity: declared chip topology + pinned cost budget
     "TOPOLOGY", "BUDGET_PRESET",
 })

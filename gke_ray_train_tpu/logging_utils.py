@@ -1,10 +1,15 @@
-"""Shared warn-once machinery.
+"""Shared logging machinery: warn-once + run-correlation prefixing.
 
 Trace-time fallback warnings (dense-mask attention fallback, dense
 prefill, shallow pipeline microbatches, unknown MFU roofline) must fire
 once per distinct shape/config key — not once per step, and not
 silently. One seen-set for the whole package so the pattern cannot
 drift per module (ADVICE-style reuse; was four private copies).
+
+``configure_run_logging`` stamps every stdlib log line with the same
+``run_id``/``attempt``/``rank`` correlation fields the obs event
+stream carries (``obs/events.py`` STAMP_FIELDS), so text logs and
+events join on one grep: ``grep 'run=<id>' worker.log events-*.jsonl``.
 """
 
 from __future__ import annotations
@@ -12,6 +17,58 @@ from __future__ import annotations
 import logging
 
 _seen: set = set()
+_run_filter = None
+
+
+class _RunContextFilter(logging.Filter):
+    """Prepend ``[run=<id> a<attempt> r<rank>]`` to every record, once
+    (a record passing through several handlers must not stack prefixes;
+    the prefix is a literal — no ``%`` — so ``record.args`` stay
+    valid)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self.prefix = prefix
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not getattr(record, "_run_prefixed", False):
+            record.msg = f"{self.prefix} {record.msg}"
+            record._run_prefixed = True
+        return True
+
+
+def configure_run_logging(run_id, attempt, rank) -> str:
+    """Install (or replace — one filter per process, re-armed each
+    attempt) the correlation prefix on every root handler. Returns the
+    prefix. With no root handler yet, ``basicConfig`` is applied first
+    so worker processes spawned without an entry script still carry
+    the fields."""
+    global _run_filter
+    prefix = f"[run={run_id} a{int(attempt)} r{rank}]"
+    root = logging.getLogger()
+    if not root.handlers:
+        logging.basicConfig(level=logging.INFO,
+                            format="%(asctime)s %(name)s: %(message)s")
+    for h in root.handlers:
+        if _run_filter is not None:
+            h.removeFilter(_run_filter)
+    _run_filter = _RunContextFilter(prefix)
+    for h in root.handlers:
+        h.addFilter(_run_filter)
+    return prefix
+
+
+def clear_run_logging() -> None:
+    """Remove the correlation prefix (attempt end — the next attempt
+    re-arms). The filter MUTATES records, so leaving it installed
+    outside an attempt would stamp unrelated log lines (and break any
+    caller asserting on raw messages)."""
+    global _run_filter
+    if _run_filter is None:
+        return
+    for h in logging.getLogger().handlers:
+        h.removeFilter(_run_filter)
+    _run_filter = None
 
 
 def warn_once(logger: logging.Logger, key, msg: str, *args) -> None:

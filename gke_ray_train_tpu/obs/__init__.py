@@ -1,0 +1,19 @@
+"""obs/ — unified run telemetry (ISSUE 11).
+
+- ``events``  — structured per-rank JSONL event stream (pinned schema)
+- ``metrics`` — counters/gauges/histograms + Prometheus/JSON exporters
+- ``capture`` — anomaly-triggered one-shot ``jax.profiler`` captures
+- ``runtime`` — the per-process session everything emits through
+- ``report``  — one merged, reconciled report per run
+  (CLI: ``python -m gke_ray_train_tpu.obs report <run_dir>``)
+
+Stdlib-only at import: the driver, the supervisor, and the report run
+without jax.
+"""
+
+from gke_ray_train_tpu.obs.events import (  # noqa: F401
+    EVENT_KINDS, STAMP_FIELDS, EventLog, iter_events, validate_event)
+from gke_ray_train_tpu.obs.metrics import (  # noqa: F401
+    METRIC_NAMES, MetricsRegistry)
+from gke_ray_train_tpu.obs.runtime import (  # noqa: F401
+    active, emit, registry, resolve_obs_dir, start_attempt, end_attempt)

@@ -1,0 +1,77 @@
+"""CLI: ``python -m gke_ray_train_tpu.obs <verb>``.
+
+Verbs:
+
+- ``report <run_dir>`` — merge the run's events/metrics/ledger/bench
+  records into ``<obs_dir>/report.json``, print ONE JSON summary line
+  on stdout (the record_baselines.sh / driver contract; ``--text``
+  additionally renders the per-attempt timeline on stderr).
+- ``schema`` — validate the shipped event + metric schema files
+  against the code's pinned vocabularies (the CI lint step).
+
+Exit codes (pinned by tests/test_obs.py):
+  0 ok · 1 run dir unreadable / no telemetry / schema drift ·
+  2 usage (argparse) · 3 ledger reconciliation failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="python -m gke_ray_train_tpu.obs")
+    sub = p.add_subparsers(dest="verb", required=True)
+    rp = sub.add_parser("report", help="one report per run dir")
+    rp.add_argument("run_dir")
+    rp.add_argument("--out", default=None,
+                    help="report.json path (default: <obs_dir>/report.json)")
+    rp.add_argument("--text", action="store_true",
+                    help="also render the human timeline (stderr)")
+    sub.add_parser("schema", help="validate shipped schema files")
+    args = p.parse_args(argv)
+
+    if args.verb == "schema":
+        from gke_ray_train_tpu.obs import events, metrics
+        findings = events.check_schema() + metrics.check_schema()
+        for f in findings:
+            print(f"SCHEMA: {f}", file=sys.stderr)
+        print(json.dumps({"verb": "schema",
+                          "findings": len(findings),
+                          "ok": not findings}))
+        return 1 if findings else 0
+
+    from gke_ray_train_tpu.obs.report import (
+        ReportError, render_text, write_report)
+    try:
+        report = write_report(args.run_dir, args.out)
+    except ReportError as e:
+        print(f"obs report: {e}", file=sys.stderr)
+        return 1
+    if args.text:
+        print(render_text(report), file=sys.stderr)
+    summary = {
+        "metric": f"obs report {report['run_id']}",
+        "value": report["n_attempts"], "unit": "attempts",
+        "reconciled": report["reconciled"],
+        "anomalies": len(report["anomalies"]),
+        "captures": len(report["captures"]),
+        "reshards": sum(len(a.get("reshard", []))
+                        for a in report["attempts"]),
+        "events": report["event_count"],
+        "goodput_frac": round((report.get("goodput") or {}).get(
+            "goodput_frac", 0.0), 4),
+        "report": report["report_path"],
+    }
+    print(json.dumps(summary))
+    if not report["reconciled"]:
+        print("obs report: ledger terms do NOT reconcile to attempt "
+              "wall-clock — telemetry bug", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,321 @@
+"""Anomaly-triggered profiler capture (ISSUE 11 tentpole, part 3).
+
+``train/profiling.py`` can trace a FIXED window of steps — almost
+always a warm, boring one. This module arms a one-shot ``jax.profiler``
+capture the moment an anomaly is DETECTED, so the trace that exists is
+the trace of the bad window:
+
+- **step_time_spike** — the host's per-iteration wall time jumps past
+  ``spike_factor`` x its trailing median. Under async dispatch the host
+  loop runs ahead and is back-pressured by the device, so host
+  iteration time tracks device step time without any added sync.
+- **data_stall** — the input-pipeline wait for one batch exceeds
+  ``stall_factor`` x the median iteration time (what prefetch should
+  drive to ~0; ``data/prefetch.py``).
+- **recompile** — the jax.monitoring backend-compile counter ticks
+  after the warmup window (shape/dtype/sharding churn mid-run; the
+  same signal ``analysis/jaxprcheck.py`` lints for, caught live).
+- **stalled_rank** — driver-side: the heartbeat watchdog names a rank
+  with no step progress; on the local path a best-effort capture runs
+  BEFORE the attempt is killed (the device may still be executing the
+  wedged collective — exactly the trace worth keeping).
+
+Budget discipline: each anomaly class fires AT MOST ONE capture per
+attempt, a global per-attempt capture budget bounds the disk/overhead,
+and only one trace is active at a time (a pending class queues behind
+the active capture; ``jax.profiler`` is process-global). Detection
+itself is a handful of float comparisons per step on numbers the loop
+already measured — nothing here syncs the device.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+ANOMALY_CLASSES = ("step_time_spike", "data_stall", "recompile",
+                   "stalled_rank")
+
+# backend-compile monitoring event (the constant jaxprcheck pins)
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_compile_count = 0
+_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    """Count backend compiles process-wide via jax.monitoring (installed
+    once, kept — the listener is a counter increment)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+
+        def _on_duration(event, duration, **kw):
+            global _compile_count
+            if event == _BACKEND_COMPILE_EVENT:
+                _compile_count += 1
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listener_installed = True
+    except Exception as e:  # noqa: BLE001 - private API; detector off
+        logger.warning("backend-compile listener unavailable (%s); "
+                       "recompile anomaly detection disabled", e)
+
+
+def backend_compile_count() -> int:
+    return _compile_count
+
+
+class CaptureManager:
+    """Per-attempt anomaly detector + one-shot capture scheduler.
+
+    The loop calls :meth:`note_step` once per completed step with the
+    host iteration wall time (eval/ckpt pauses and the data wait
+    already excluded by the caller) and the data wait; detections emit
+    ``anomaly``/``capture`` events through ``emit_fn`` and bump the
+    registry counters. Captures reuse ``TraceProfiler`` (imported
+    lazily — this module stays importable without jax) aimed at
+    ``<obs_dir>/captures/<class>-step<k>``, each with a ``capture.json``
+    marker so ``obs report`` can inventory artifacts without parsing
+    XLA trace files.
+    """
+
+    def __init__(self, obs_dir: str, *,
+                 emit_fn: Optional[Callable] = None,
+                 registry=None,
+                 budget: int = 4,
+                 num_steps: int = 2,
+                 warmup_steps: int = 5,
+                 spike_factor: float = 3.0,
+                 stall_factor: float = 2.0,
+                 min_stall_s: float = 0.02,
+                 trace_conflict: Optional[Callable[[], bool]] = None):
+        self.obs_dir = obs_dir
+        self.emit = emit_fn or (lambda *a, **k: None)
+        self.registry = registry
+        self.budget = int(budget)
+        self.num_steps = int(num_steps)
+        self.warmup_steps = int(warmup_steps)
+        self.spike_factor = float(spike_factor)
+        self.stall_factor = float(stall_factor)
+        self.min_stall_s = float(min_stall_s)
+        # external in-flight trace (the config-gated TraceProfiler
+        # window): jax.profiler is process-global, never start a second
+        self._conflict = trace_conflict or (lambda: False)
+        self.fired: Dict[str, int] = {}        # class -> trigger step
+        self.captured: List[Dict[str, Any]] = []
+        self._iter_times = collections.deque(maxlen=32)
+        self._steps_seen = 0
+        self._compile_base: Optional[int] = None
+        self._active: Optional[dict] = None     # {profiler, class, step}
+        self._pending: List[tuple] = []         # (class, step, detail)
+        # wall seconds this manager itself spent starting/stopping
+        # traces since the last note_step — subtracted from the next
+        # sample, or the capture's own cost reads as a step-time spike
+        self._self_s = 0.0
+        _install_compile_listener()
+
+    # -- detection -----------------------------------------------------
+
+    def _median(self) -> float:
+        return statistics.median(self._iter_times) \
+            if self._iter_times else 0.0
+
+    def note_step(self, step: int, iter_s: float, wait_s: float) -> None:
+        """Once per completed step. ``iter_s`` = host wall since the
+        previous step, minus data wait and eval/ckpt pauses (the
+        caller's ledger already tracks those); ``wait_s`` = the input
+        pipeline wait for this batch."""
+        self._steps_seen += 1
+        iter_s = max(iter_s - self._self_s, 0.0)
+        self._self_s = 0.0
+        if self.registry is not None:
+            self.registry.counter("steps_total").inc()
+            self.registry.histogram("step_time_s").observe(iter_s)
+            if wait_s > 0:
+                self.registry.histogram("data_wait_s").observe(wait_s)
+        # recompile: any backend compile after the baseline snapshot
+        # (taken once warmup completes — the first-step compile, a
+        # first eval compile and resume rebuilds are legitimate)
+        count = backend_compile_count()
+        if self.registry is not None:
+            self.registry.counter("backend_compiles_total").value = count
+        if self._steps_seen == self.warmup_steps:
+            self._compile_base = count
+        elif self._compile_base is not None and count > self._compile_base:
+            self._compile_base = count
+            self._anomaly("recompile", step,
+                          {"backend_compiles": count})
+        med = self._median()
+        warm = self._steps_seen > self.warmup_steps and med > 0
+        if warm and iter_s > max(self.spike_factor * med, med + 0.01):
+            self._anomaly("step_time_spike", step,
+                          {"iter_s": round(iter_s, 4),
+                           "median_s": round(med, 4)})
+        if warm and wait_s > max(self.stall_factor * med,
+                                 self.min_stall_s):
+            self._anomaly("data_stall", step,
+                          {"wait_s": round(wait_s, 4),
+                           "median_step_s": round(med, 4)})
+        # the sample window feeds the median AFTER detection so the
+        # spike itself does not drag the baseline up before it is seen
+        self._iter_times.append(max(iter_s, 0.0))
+        self._drive(step)
+
+    def note_stalled_rank(self, detail: Dict[str, Any],
+                          seconds: float = 0.5) -> None:
+        """Driver/watchdog path: capture NOW (bounded), synchronously —
+        by the time a stall is named the loop is not stepping, so the
+        step-driven scheduler below never runs."""
+        step = int(detail.get("step", -1))
+        if not self._anomaly("stalled_rank", step, detail):
+            return
+        if self._active is not None:
+            # one trace at a time: seal our own in-flight capture first
+            # (the loop is wedged, it was never going to finish; the
+            # partial trace is still evidence) so start_trace below
+            # does not collide with it
+            try:
+                self._active["profiler"].close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            self._finish_active()
+        if self._budget_left() and not self._conflict():
+            self._capture_now("stalled_rank", step, seconds)
+
+    # -- capture scheduling --------------------------------------------
+
+    def _budget_left(self) -> bool:
+        return len(self.captured) < self.budget
+
+    def _anomaly(self, cls: str, step: int, detail: Dict[str, Any]
+                 ) -> bool:
+        """Record one anomaly; returns True when this is the class's
+        FIRST firing this attempt (the one that may arm a capture)."""
+        if cls in self.fired:
+            return False
+        self.fired[cls] = int(step)
+        if self.registry is not None:
+            self.registry.counter("anomalies_total").inc()
+        logger.warning("obs anomaly %s at step %d: %s", cls, step, detail)
+        self.emit("anomaly", step=step, **{"class": cls},
+                  detail=detail, trigger_step=int(step))
+        if cls != "stalled_rank" and self._budget_left():
+            self._pending.append((cls, int(step), detail))
+        return True
+
+    def _capture_dir(self, cls: str, step: int) -> str:
+        return os.path.join(self.obs_dir, "captures", f"{cls}-step{step}")
+
+    def _drive(self, step: int) -> None:
+        """Advance the active capture / start the next pending one.
+        Called from note_step — captures trace the steps FOLLOWING the
+        trigger (the bad regime is usually still in effect; the trigger
+        step itself is already gone)."""
+        t0 = time.perf_counter()
+        try:
+            self._drive_inner(step)
+        finally:
+            # trace start/stop cost is the manager's own, not the
+            # step's — keep it out of the next anomaly sample
+            self._self_s += time.perf_counter() - t0
+
+    def _drive_inner(self, step: int) -> None:
+        if self._active is not None:
+            prof = self._active["profiler"]
+            prof.step(step)
+            if prof._done:
+                self._finish_active()
+        if self._active is None and self._pending:
+            if self._conflict():
+                return          # retry at the next step boundary
+            cls, t_step, _detail = self._pending.pop(0)
+            if not self._budget_left():
+                self._pending.clear()
+                return
+            from gke_ray_train_tpu.train.profiling import TraceProfiler
+            logdir = self._capture_dir(cls, t_step)
+            os.makedirs(logdir, exist_ok=True)
+            self._active = {
+                "profiler": TraceProfiler(logdir, start_step=1,
+                                          num_steps=self.num_steps),
+                "class": cls, "trigger_step": t_step,
+                "t0": time.perf_counter()}
+
+    def _finish_active(self) -> None:
+        a, self._active = self._active, None
+        if a is None:
+            return
+        artifact = a["profiler"].logdir
+        # a profiler whose start_trace failed marks itself done without
+        # ever arming a stop step — that capture produced NO trace and
+        # must be reported failed, not as a good artifact
+        started = a["profiler"]._stop_at is not None
+        ok = self._write_marker(a["class"], a["trigger_step"],
+                                artifact) and started
+        self.captured.append({"class": a["class"],
+                              "trigger_step": a["trigger_step"],
+                              "artifact": artifact})
+        if self.registry is not None:
+            self.registry.counter("captures_total").inc()
+        self.emit("capture", step=a["trigger_step"],
+                  **{"class": a["class"]}, artifact=artifact,
+                  num_steps=self.num_steps,
+                  trigger_step=a["trigger_step"], failed=not ok)
+
+    def _capture_now(self, cls: str, step: int, seconds: float) -> None:
+        """Synchronous bounded trace (stalled_rank only): whatever the
+        device is doing RIGHT NOW is the evidence."""
+        import jax
+        logdir = self._capture_dir(cls, step)
+        os.makedirs(logdir, exist_ok=True)
+        ok = True
+        try:
+            jax.profiler.start_trace(logdir)
+            time.sleep(max(seconds, 0.05))
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 - wedged backend likely
+            ok = False
+            logger.warning("stalled-rank capture failed: %s", e)
+        self._write_marker(cls, step, logdir)
+        self.captured.append({"class": cls, "trigger_step": step,
+                              "artifact": logdir})
+        if self.registry is not None:
+            self.registry.counter("captures_total").inc()
+        self.emit("capture", step=step, **{"class": cls},
+                  artifact=logdir, num_steps=0, trigger_step=step,
+                  failed=not ok)
+
+    def _write_marker(self, cls: str, step: int, logdir: str) -> bool:
+        """capture.json beside the trace — the artifact inventory
+        ``obs report`` reads (XLA trace layouts vary by backend)."""
+        import json
+        try:
+            with open(os.path.join(logdir, "capture.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump({"class": cls, "trigger_step": int(step),
+                           "num_steps": self.num_steps,
+                           "ts": time.time()}, f)
+            return True
+        except OSError as e:  # pragma: no cover
+            logger.warning("capture marker write failed: %s", e)
+            return False
+
+    def close(self) -> None:
+        """Attempt end: stop an in-flight capture (the partial trace is
+        still evidence) and drop anything pending."""
+        if self._active is not None:
+            try:
+                self._active["profiler"].close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+            self._finish_active()
+        self._pending.clear()

@@ -1,0 +1,233 @@
+"""Structured run-event stream — the machine-readable record of what
+happened when (ISSUE 11 tentpole, part 1).
+
+Every interesting boundary of a run — attempt start/end, checkpoint
+resume, the first (compiling) step, periodic step metrics, eval,
+checkpoint saves, preemption exits, elastic reshards, anomalies and
+their profiler captures, serving drains — lands as ONE JSON line in a
+per-rank file ``<obs_dir>/events-r<rank>.jsonl`` (the driver writes
+``events-driver.jsonl``). Each record is stamped with the same
+correlation fields (:data:`STAMP_FIELDS`): ``run_id`` / ``attempt`` /
+``rank`` / ``slice`` / ``step`` / ``plan_fingerprint``, so one grep
+joins the event stream, the prefixed text logs
+(``logging_utils.configure_run_logging``) and the metric exports.
+
+The event vocabulary is CLOSED: :data:`EVENT_KINDS` is pinned by the
+shipped schema file (``obs/schemas/events.schema.json``) and by
+``tests/test_obs.py`` — a renamed kind fails the contract test instead
+of silently orphaning old run dirs. Emission sits OFF the hot path by
+construction: events fire at boundaries (log cadence at the fastest),
+never per step, and never fetch device values themselves — payloads are
+host values the caller already had.
+
+Stdlib-only by design (the supervisor/trainer driver side must import
+this without jax).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+logger = logging.getLogger(__name__)
+
+# correlation fields stamped on EVERY record, in this order
+STAMP_FIELDS = ("ts", "run_id", "attempt", "rank", "slice", "step",
+                "plan_fingerprint", "kind")
+
+# the closed event vocabulary: kind -> allowed payload fields. Pinned by
+# obs/schemas/events.schema.json and the test_obs contract test.
+EVENT_KINDS: Dict[str, tuple] = {
+    # attempt lifecycle (worker side)
+    "attempt_start": ("topology", "n_devices", "pool", "mesh"),
+    "resume": ("resumed_step",),
+    "first_step": ("compile_s", "restart_to_first_step_s",
+                   "fast_forward_s", "restore_s"),
+    "step": ("epoch", "loss", "learning_rate", "grad_norm",
+             "tokens_per_sec_per_chip", "mfu", "data_stall_frac"),
+    "eval": ("metrics",),
+    "ckpt_save": ("save_s", "forced"),
+    "epoch_end": ("epoch",),
+    "preempt_exit": ("save_s", "grace_remaining_s", "pool"),
+    "worker_exit": ("status", "goodput"),
+    # attempt lifecycle (driver side — the reconciliation source)
+    "attempt_end": ("status", "goodput", "event", "pool", "error",
+                    "resumed_step", "ckpt_save_s"),
+    "run_end": ("status", "attempts", "preemptions", "goodput"),
+    # elastic / supervision
+    "reshard": ("from_devices", "to_devices", "from_fingerprint",
+                "to_fingerprint", "mesh", "per_device_batch"),
+    "stall": ("stalled", "timeout_s"),
+    # anomaly-triggered profiling (obs/capture.py)
+    "anomaly": ("class", "detail", "trigger_step"),
+    "capture": ("class", "artifact", "num_steps", "trigger_step",
+                "failed"),
+    # serving (serve/engine.py / rayint/serving.py)
+    "serve_start": ("replica", "executables"),
+    "serve_drained": ("replica", "stats"),
+    # entry-script artifacts
+    "export": ("path", "what"),
+}
+
+
+class EventError(ValueError):
+    """An event violated the pinned schema (unknown kind / stray field)."""
+
+
+def validate_event(kind: str, payload: Dict[str, Any]) -> None:
+    """Schema teeth at the emit site: unknown kinds and undeclared
+    payload fields raise — the contract the report/CI rely on is
+    enforced where the event is born, not discovered at read time."""
+    allowed = EVENT_KINDS.get(kind)
+    if allowed is None:
+        raise EventError(f"unknown event kind {kind!r}; known: "
+                         f"{sorted(EVENT_KINDS)}")
+    stray = sorted(set(payload) - set(allowed) - set(STAMP_FIELDS))
+    if stray:
+        raise EventError(f"event kind {kind!r} does not declare payload "
+                         f"fields {stray} (allowed: {sorted(allowed)})")
+
+
+def _json_safe(v: Any) -> Any:
+    """Coerce payload values to JSON-serializable types (numpy scalars
+    and arrays arrive from host metric dicts)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)[:200]
+
+
+class EventLog:
+    """Append-only JSONL event writer for one (rank, attempt) stream.
+
+    The file is opened in append mode (a retry in the same process or a
+    later attempt writing the same rank file extends, never truncates —
+    the ``attempt`` stamp keeps the streams separable) and flushed per
+    record: events are boundary-rate, and the record must survive the
+    SIGKILL that usually follows the interesting ones.
+    """
+
+    def __init__(self, path: str, *, run_id: str, attempt: int,
+                 rank: Union[int, str], slice_index: Optional[int] = None,
+                 plan_fingerprint: Optional[str] = None):
+        self.path = path
+        self.run_id = str(run_id)
+        self.attempt = int(attempt)
+        self.rank = rank
+        self.slice_index = slice_index
+        self.plan_fingerprint = plan_fingerprint
+        self._step: Optional[int] = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def set_step(self, step: Optional[int]) -> None:
+        """Current train step, stamped on subsequent records whose
+        caller does not pass one (e.g. serve/anomaly paths)."""
+        self._step = step
+
+    def emit(self, kind: str, step: Optional[int] = None,
+             **payload: Any) -> Dict[str, Any]:
+        validate_event(kind, payload)
+        rec: Dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "run_id": self.run_id,
+            "attempt": self.attempt,
+            "rank": self.rank,
+            "slice": self.slice_index,
+            "step": self._step if step is None else int(step),
+            "plan_fingerprint": self.plan_fingerprint,
+            "kind": kind,
+        }
+        rec.update({k: _json_safe(v) for k, v in payload.items()})
+        if self._f is not None and not self._f.closed:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+        return rec
+
+    def close(self) -> None:
+        try:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+
+
+def events_path(obs_dir: str, rank: Union[int, str]) -> str:
+    return os.path.join(obs_dir, f"events-r{rank}.jsonl")
+
+
+def iter_events(obs_dir: str,
+                kinds: Optional[Iterable[str]] = None
+                ) -> Iterator[Dict[str, Any]]:
+    """Every event record under ``obs_dir`` (all ranks + driver),
+    sorted by timestamp. Corrupt lines (a SIGKILL mid-write) are
+    skipped with a warning, never fatal — the report must render what
+    survived."""
+    want = set(kinds) if kinds is not None else None
+    out: List[Dict[str, Any]] = []
+    try:
+        names = sorted(os.listdir(obs_dir))
+    except OSError:
+        return iter(())
+    for name in names:
+        if not (name.startswith("events-") and name.endswith(".jsonl")):
+            continue
+        path = os.path.join(obs_dir, name)
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    logger.warning("%s:%d: skipping corrupt event line",
+                                   path, i + 1)
+                    continue
+                if want is None or rec.get("kind") in want:
+                    out.append(rec)
+    out.sort(key=lambda r: (r.get("ts", 0.0), str(r.get("rank"))))
+    return iter(out)
+
+
+def schema_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "schemas", "events.schema.json")
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(schema_path(), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check_schema() -> List[str]:
+    """Shipped schema file <-> code contract: the file must parse and
+    pin exactly the vocabulary this module enforces. Returns findings
+    (empty = clean) — the CI lint job and test_obs both call this."""
+    findings: List[str] = []
+    try:
+        doc = load_schema()
+    except (OSError, ValueError) as e:
+        return [f"events schema unreadable: {type(e).__name__}: {e}"]
+    if tuple(doc.get("stamp", ())) != STAMP_FIELDS:
+        findings.append(f"schema stamp {doc.get('stamp')} != code "
+                        f"STAMP_FIELDS {list(STAMP_FIELDS)}")
+    kinds = doc.get("kinds", {})
+    if set(kinds) != set(EVENT_KINDS):
+        findings.append(
+            f"schema kinds {sorted(set(kinds) ^ set(EVENT_KINDS))} "
+            "drifted from code EVENT_KINDS")
+    for k in set(kinds) & set(EVENT_KINDS):
+        if tuple(kinds[k]) != tuple(EVENT_KINDS[k]):
+            findings.append(f"schema kind {k!r} fields {kinds[k]} != "
+                            f"code {list(EVENT_KINDS[k])}")
+    return findings

@@ -146,6 +146,19 @@ class ExecutionPlan:
     # weight quantization the replica serves: "none" | "int8" | "nf4"
     serve_quant: str = "none"
 
+    # -- observability (obs/) -------------------------------------------
+    # unified run telemetry: structured events + metric exports into
+    # the run's obs dir (obs/runtime.py resolves OBS_DIR, else
+    # <output dir>/obs; unresolvable = off). Operational, never
+    # compile-relevant — toggling telemetry must not stale a sidecar.
+    obs: bool = True
+    obs_dir: Optional[str] = None             # None = derive from run dir
+    # anomaly-triggered one-shot jax.profiler captures (obs/capture.py):
+    # step-time spike / data stall / recompile / stalled rank, each
+    # fires at most once per attempt, bounded by the capture budget
+    obs_capture: bool = True
+    obs_capture_budget: int = 4
+
     # -- identity --------------------------------------------------------
     topology: str = "cpu-8"                   # key into CHIP_COUNTS
     budget_preset: Optional[str] = None       # tests/budgets/<name>.json
@@ -163,7 +176,8 @@ class ExecutionPlan:
             if getattr(self, field) < 1:
                 raise PlanError(f"{field}={getattr(self, field)} must "
                                 "be >= 1")
-        for field in ("prefetch", "recompile_limit", "pipe_microbatches"):
+        for field in ("prefetch", "recompile_limit", "pipe_microbatches",
+                      "obs_capture_budget"):
             if getattr(self, field) < 0:
                 raise PlanError(f"{field}={getattr(self, field)} must "
                                 "be >= 0")
@@ -259,13 +273,21 @@ class ExecutionPlan:
     # ------------------------------------------------------------------
 
     def canonical(self) -> Dict[str, Any]:
-        """JSON-safe canonical field dict — the fingerprint payload."""
+        """JSON-safe canonical field dict — the fingerprint payload.
+        ``obs_dir`` is excluded: it is a RUN-scoped scratch/output path
+        (record_baselines points it at a mktemp dir), and two runs of
+        the byte-identical plan must fingerprint identically or the
+        stable identity budget JSONs / BENCH records / attempt logs
+        correlate on dissolves into per-run noise."""
         return {f.name: getattr(self, f.name)
-                for f in dataclasses.fields(self)}
+                for f in dataclasses.fields(self)
+                if f.name != "obs_dir"}
 
     def fingerprint(self, surface: Optional[str] = None) -> str:
         """Stable 16-hex-char identity of the declared plan — every
-        field. Recorded in budget JSONs, BENCH records, attempt logs.
+        field except the run-scoped ``obs_dir`` path (see
+        :meth:`canonical`). Recorded in budget JSONs, BENCH records,
+        attempt logs.
 
         ``surface="train"|"serve"`` narrows the identity to that
         surface's compile-relevant fields (delegates to
@@ -524,6 +546,10 @@ CONFIG_KEYS: Dict[str, str] = {
     "max_batch": "MAX_BATCH",
     "decode_buckets": "DECODE_BUCKETS",
     "serve_quant": "SERVE_QUANT",
+    "obs": "OBS",
+    "obs_dir": "OBS_DIR",
+    "obs_capture": "OBS_CAPTURE",
+    "obs_capture_budget": "OBS_CAPTURE_BUDGET",
     "topology": "TOPOLOGY",
     "budget_preset": "BUDGET_PRESET",
 }
@@ -656,16 +682,20 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
     CONFIG_KEYS[f] for f in (
         "compile_cache", "compile_cache_dir", "aot_train_step",
         "transfer_guard", "recompile_limit", "divergence_guard",
-        "prefetch")))
+        "prefetch",
+        # obs telemetry knobs ride to the workers the same way (a
+        # driver-side `env OBS_DIR=...` must shape every rank's stream)
+        "obs", "obs_dir", "obs_capture", "obs_capture_budget")))
 
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
-                          "divergence_guard"})
+                          "divergence_guard", "obs", "obs_capture"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",
                          "grad_accum", "max_seq_len", "prefetch",
-                         "recompile_limit", "max_batch"})
+                         "recompile_limit", "max_batch",
+                         "obs_capture_budget"})
 
 
 def _coerce(field: str, value: Any) -> Any:
@@ -681,7 +711,7 @@ def _coerce(field: str, value: Any) -> Any:
         if v in ("", "0", "off", "false", "allow", None):
             return None
         return v
-    if field in ("compile_cache_dir", "budget_preset"):
+    if field in ("compile_cache_dir", "budget_preset", "obs_dir"):
         return str(value) if value is not None else None
     if field == "topology":
         return str(value).strip().lower()

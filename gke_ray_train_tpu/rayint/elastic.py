@@ -110,4 +110,13 @@ def maybe_replan(plan, devices=None, *, config=None, model_cfg=None,
         plan.chips, len(devs), plan.fingerprint(), new_plan.fingerprint(),
         {a: getattr(new_plan, a) for a in new_plan.axis_names()},
         new_plan.per_device_batch, new_plan.topology)
+    # obs: the reshard is a first-class run event — `obs report`
+    # renders it on the attempt timeline (no-op when obs is off)
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
+    obs_runtime.emit(
+        "reshard", from_devices=plan.chips, to_devices=len(devs),
+        from_fingerprint=plan.fingerprint(),
+        to_fingerprint=new_plan.fingerprint(),
+        mesh={a: getattr(new_plan, a) for a in new_plan.axis_names()},
+        per_device_batch=new_plan.per_device_batch)
     return new_plan, devs

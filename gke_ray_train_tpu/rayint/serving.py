@@ -78,6 +78,14 @@ class ServeReplica:
         ``executable_info()`` — the cold-start witness (every entry
         ``"deserialized"`` on a warm sidecar dir)."""
         self._rank = int(rank)
+        # obs session for this replica process (no-op without OBS_DIR):
+        # the engine's serve_start/serve_drained events and the
+        # latency/occupancy metrics export land per replica rank, in
+        # the same correlated stream a training run writes
+        import os
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        if obs_runtime.active() is None and os.environ.get("OBS_DIR"):
+            obs_runtime.start_attempt(rank=self._rank)
         self._engine = engine_factory()
         if supervisor is not None:
             if hasattr(supervisor, "beat") and hasattr(

@@ -137,6 +137,24 @@ class HeartbeatBoard:
                               if rank in self._slices else {})}
                     for rank, (step, t) in self._last.items()}
 
+    def metrics_view(self, timeout_s: Optional[float] = None) -> dict:
+        """Serializable export view (obs satellite): the per-rank
+        last-beat age/step/slice snapshot plus — when a timeout is
+        given — the ranks currently past it, BY NAME. Consumed by the
+        driver-side obs exporter (``<obs_dir>/supervisor.json``) and by
+        ``obs report``; JSON-safe by construction (string rank keys)."""
+        snap = self.snapshot()
+        view = {"ranks": {str(r): {k: (round(v, 3)
+                                       if isinstance(v, float) else v)
+                                   for k, v in info.items()}
+                          for r, info in snap.items()}}
+        if timeout_s is not None:
+            view["timeout_s"] = float(timeout_s)
+            view["stalled"] = [
+                {"rank": r, "last_step": s, "age_s": round(age, 3)}
+                for r, s, age in self.stalled(timeout_s)]
+        return view
+
 
 class Supervisor:
     """Actor body for the Ray path: ``ray.remote(Supervisor)`` in the
@@ -158,6 +176,9 @@ class Supervisor:
     def snapshot(self) -> dict:
         return self._board.snapshot()
 
+    def metrics_view(self, timeout_s: Optional[float] = None) -> dict:
+        return self._board.metrics_view(timeout_s)
+
 
 class Watchdog:
     """Local-path supervision: a daemon thread polling a board.
@@ -170,13 +191,19 @@ class Watchdog:
 
     def __init__(self, board: HeartbeatBoard, timeout_s: float,
                  poll_s: Optional[float] = None,
-                 on_stall: Optional[Callable] = None):
+                 on_stall: Optional[Callable] = None,
+                 pre_interrupt: Optional[Callable] = None):
         self.board = board
         self.timeout_s = timeout_s
         self.poll_s = poll_s if poll_s is not None else max(
             0.01, min(timeout_s / 4.0, 5.0))
         self.stalled_info: Optional[List[StallInfo]] = None
         self._on_stall = on_stall
+        # best-effort hook fired with the stall list BEFORE the
+        # interrupt: the obs stalled-rank capture runs here — the main
+        # thread is wedged but the device may still be executing, and
+        # jax.profiler is process-global so this thread can trace it
+        self._pre_interrupt = pre_interrupt
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="heartbeat-watchdog")
@@ -203,6 +230,12 @@ class Watchdog:
             if stalled and not self._stop.is_set():
                 self.stalled_info = stalled
                 logger.error("%s", HeartbeatTimeout(stalled, self.timeout_s))
+                if self._pre_interrupt is not None:
+                    try:
+                        self._pre_interrupt(stalled)
+                    except Exception as e:  # noqa: BLE001 - never
+                        logger.warning(    # block the kill on telemetry
+                            "pre-interrupt hook failed: %s", e)
                 if self._on_stall is not None:
                     self._on_stall(stalled)
                 else:

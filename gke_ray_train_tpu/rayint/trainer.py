@@ -210,6 +210,7 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     os.environ.update(env)
     from gke_ray_train_tpu.analysis.guards import (
         install_recompile_limit, uninstall_recompile_limit)
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
     from gke_ray_train_tpu.perf.cache import (
         enable_persistent_cache, log_cache_summary)
     from gke_ray_train_tpu.plan import ExecutionPlan, PlanError
@@ -245,6 +246,17 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         # its plan in the entry) must not kill the attempt here
         logger.warning("worker-level plan resolution failed (%s); the "
                        "entry's own plan still applies", e)
+    # attempt-scoped obs session (obs/runtime.py): per-rank event
+    # stream + metrics registry + anomaly captures into the run's obs
+    # dir, and the run_id/attempt/rank prefix on every text log line.
+    # No-op (None) when obs is off or no dir resolves — the bare test
+    # path stays telemetry-free.
+    obs = obs_runtime.start_attempt(plan=plan, config=config)
+    if obs is not None:
+        obs.emit("attempt_start",
+                 topology=plan.topology if plan is not None else None,
+                 n_devices=plan.chips if plan is not None else None,
+                 pool=os.environ.get("ELASTIC_N_DEVICES"))
     # compile-once across restarts: every attempt (and every retry of a
     # preempted worker) reuses the persistent XLA cache instead of
     # paying a full recompile. Config-only here — the backend must not
@@ -283,6 +295,15 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
                 "goodput": ctx.goodput,
                 "plan_fingerprint": ctx.plan_fingerprint}
     finally:
+        # seal the attempt's obs session on every path: worker_exit
+        # event (with the ledger the loop parked on the context), final
+        # metric export, stream closed — BEFORE the mesh teardown below
+        import sys as _sys
+        _exc = _sys.exc_info()[1]
+        obs_runtime.end_attempt(
+            "ok" if _exc is None else
+            ("preempted" if _find_preempted(_exc) is not None
+             else "failed"))
         # one line of compile-cache health per attempt: a warm restart
         # should show hits ≈ compile count and seconds saved
         log_cache_summary(logger)
@@ -339,6 +360,11 @@ class JaxTrainer:
         # set, every subsequent attempt's workers see it as
         # ELASTIC_N_DEVICES and re-form their mesh on it
         self._pool_override: Optional[int] = None
+        # obs identity: fit() mints one OBS_RUN_ID per run and stamps
+        # OBS_ATTEMPT per attempt into every worker's env, so all
+        # ranks of all attempts correlate into one stream
+        self._attempt = 0
+        self._obs = None
 
     # -- elastic knobs -------------------------------------------------
     def _elastic(self) -> bool:
@@ -354,8 +380,14 @@ class JaxTrainer:
         return min_devices(self.config)
 
     def _pool_env(self) -> Dict[str, str]:
-        """Per-attempt worker env for the elastic pool override."""
+        """Per-attempt worker env: the elastic pool override plus the
+        obs run/attempt identity stamps."""
         env: Dict[str, str] = {}
+        if self._obs is not None:
+            # both worker paths route through _run_worker, whose first
+            # action is os.environ.update(env) — one write site
+            env["OBS_RUN_ID"] = self._obs.run_id
+            env["OBS_ATTEMPT"] = str(self._attempt or 1)
         # a RunConfig(elastic=True) opt-in must reach the worker-side
         # gate too (rayint/elastic.py reads config/env only) — else the
         # driver arms the override and the workers refuse to replan
@@ -389,7 +421,21 @@ class JaxTrainer:
                **self._pool_env()}
         hb = self.run_config.heartbeat_timeout_s
         board = HeartbeatBoard() if hb else None
-        wd = Watchdog(board, hb).start() if hb else None
+
+        def _stall_capture(stalled):
+            # obs stalled-rank anomaly (obs/capture.py): a best-effort
+            # trace of whatever the device is doing RIGHT NOW, taken on
+            # the watchdog thread before the wedged main thread is
+            # interrupted — the only moment that trace can exist
+            from gke_ray_train_tpu.obs import runtime as obs_runtime
+            run = obs_runtime.active()
+            if run is not None and run.capture is not None:
+                run.capture.note_stalled_rank(
+                    {"stalled": [list(s) for s in stalled],
+                     "step": max((s[1] for s in stalled), default=-1)})
+
+        wd = Watchdog(board, hb,
+                      pre_interrupt=_stall_capture).start() if hb else None
         # the outer try also covers the cleanup and the return: a
         # watchdog SIGINT raised while the finally runs (worker finished
         # in the detection race window) must still be translated, not
@@ -401,6 +447,8 @@ class JaxTrainer:
             finally:
                 if wd is not None:
                     wd.stop()
+                if board is not None and self._obs is not None:
+                    self._obs.export_supervisor(board.metrics_view(hb))
                 get_context().set_heartbeat_sink(None)
             return Result(metrics=out["metrics"]), out
         except KeyboardInterrupt:
@@ -625,6 +673,15 @@ class JaxTrainer:
             results = [self._get_result(f, i, ips)
                        for i, f in enumerate(futures)]
         finally:
+            # obs supervisor export (driver side, best-effort): the
+            # per-rank last-beat view — on a stall it NAMES the dead
+            # rank in <obs_dir>/supervisor.json for `obs report`
+            if supervisor is not None and self._obs is not None:
+                try:
+                    self._obs.export_supervisor(ray.get(
+                        supervisor.metrics_view.remote(hb_timeout)))
+                except Exception:  # noqa: BLE001 - telemetry only
+                    pass
             # PGs outlive their Python handles; without removal a retry
             # attempt would create a second PG against resources the
             # first still reserves and deadlock in pg.ready()
@@ -655,6 +712,7 @@ class JaxTrainer:
         return led, fp
 
     def fit(self) -> Result:
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
         from gke_ray_train_tpu.train.metrics import (
             finish_ledger, sum_ledgers)
         fc = self.run_config.failure_config
@@ -667,6 +725,12 @@ class JaxTrainer:
         preemptions = 0
         attempt = 0
         attempt_log: list = []
+        # driver-side obs stream (obs/runtime.py): mints the shared
+        # OBS_RUN_ID, then records one `attempt_end` per attempt — the
+        # FINISHED ledger (lost_s = attempt-wall residual, so terms sum
+        # to wall exactly) that `obs report` reconciles against — plus
+        # the final `run_end`. None when obs is off / no dir resolves.
+        self._obs = obs_runtime.start_driver(config=self.config)
 
         def finalize(result: Result) -> Result:
             result.attempts = attempt
@@ -674,7 +738,15 @@ class JaxTrainer:
             result.attempt_log = attempt_log
             result.goodput = sum_ledgers(
                 [e["goodput"] for e in attempt_log if "goodput" in e])
+            if self._obs is not None:
+                self._obs.note_run_end(result)
+                self._obs.close()
+                self._obs = None
             return result
+
+        def note_attempt(entry: dict) -> None:
+            if self._obs is not None:
+                self._obs.note_attempt(attempt, entry)
 
         def classify_pool(p, entry, exc=None) -> Optional[Result]:
             """Elastic post-mortem: did the device pool change? Reads
@@ -713,6 +785,10 @@ class JaxTrainer:
                 logger.error("%s", msg)
                 entry["status"] = "failed"
                 entry["error"] = msg
+                # the terminal attempt must be noted BEFORE finalize
+                # emits run_end and closes the driver stream — the
+                # caller's note_attempt would hit a closed session
+                note_attempt(entry)
                 return finalize(Result(metrics={}, error=msg,
                                        status="failed"))
             self._pool_override = int(pool)
@@ -724,6 +800,7 @@ class JaxTrainer:
 
         while True:
             attempt += 1
+            self._attempt = attempt       # stamped into worker env
             t_attempt = time.perf_counter()
             try:
                 result, out = self._fit_ray() if self.use_ray \
@@ -739,12 +816,21 @@ class JaxTrainer:
                 if self._pool_override is not None:
                     entry["pool"] = self._pool_override
                 attempt_log.append(entry)
+                note_attempt(entry)
                 return finalize(result)
             except Exception as e:  # noqa: BLE001 - classified below
                 wall = time.perf_counter() - t_attempt
                 p = _find_preempted(e)
                 led, fp = self._local_attempt_note(p)
                 goodput = finish_ledger(led, wall)
+                if self._obs is not None:
+                    from gke_ray_train_tpu.rayint.supervisor import (
+                        HeartbeatTimeout)
+                    for x in _cause_chain(e):
+                        if isinstance(x, HeartbeatTimeout):
+                            self._obs.note_stall(x.stalled, x.timeout_s,
+                                                 attempt=attempt)
+                            break
                 if p is not None:
                     # preempted: checkpointed within the grace window and
                     # exited cleanly — not a failure, does NOT consume
@@ -761,7 +847,8 @@ class JaxTrainer:
                     attempt_log.append(entry)
                     stop = classify_pool(p, entry)
                     if stop is not None:
-                        return stop
+                        return stop      # classify noted the attempt
+                    note_attempt(entry)
                     if preemptions > fc.max_preemptions:
                         logger.error(
                             "preemption budget exhausted "
@@ -786,6 +873,7 @@ class JaxTrainer:
                     if fp:
                         entry["plan_fingerprint"] = fp
                     attempt_log.append(entry)
+                    note_attempt(entry)
                     return finalize(Result(metrics={}, error=str(e),
                                            status="failed"))
                 # a failure whose post-mortem shows the pool changed
@@ -800,10 +888,11 @@ class JaxTrainer:
                 attempt_log.append(entry)
                 stop = classify_pool(None, entry, exc=e)
                 if stop is not None:
-                    return stop
+                    return stop          # classify noted the attempt
                 if entry.get("event"):
                     entry["status"] = "preempted"
                     preemptions += 1
+                    note_attempt(entry)
                     if preemptions > fc.max_preemptions:
                         logger.error(
                             "preemption budget exhausted "
@@ -817,6 +906,7 @@ class JaxTrainer:
                         "max_failures budget untouched)", attempt, e,
                         entry["pool"], preemptions, fc.max_preemptions)
                     continue
+                note_attempt(entry)
                 failures += 1
                 logger.exception(
                     "training attempt %d failed (failure %d/%d)",

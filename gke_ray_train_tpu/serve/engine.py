@@ -361,6 +361,11 @@ class BatchEngine:
         for w in widths or self.buckets:
             for kind in ("prefill", "decode", "insert"):
                 self._get(kind, w)
+        # obs: record the cold-start provenance (deserialized vs
+        # compiled, per executable) on the run's event stream
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        obs_runtime.emit("serve_start",
+                         executables=self.executable_info())
 
     # -- request intake ------------------------------------------------
 
@@ -526,6 +531,12 @@ class BatchEngine:
         want = [r.rid for r in requests]
         while self.step() > 0:
             pass
+        # obs: serving latency/occupancy into the shared metrics
+        # registry + one `serve_drained` event (off the decode loop —
+        # once per drain, never per iteration; no-op when obs is off)
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        if obs_runtime.active() is not None:
+            obs_runtime.active().note_serve(self.stats())
         if want:
             return [self._completions.pop(rid) for rid in want]
         out = list(self._completions.values())

@@ -28,6 +28,7 @@ import numpy as np
 
 from gke_ray_train_tpu.analysis.guards import RuntimeGuards, allow_transfers
 from gke_ray_train_tpu.data.prefetch import make_batch_source
+from gke_ray_train_tpu.obs import runtime as obs_runtime
 from gke_ray_train_tpu.train import preempt
 from gke_ray_train_tpu.train.metrics import (
     GoodputLedger, ThroughputMeter, paused)
@@ -133,6 +134,19 @@ def run_training(state: TrainState,
     # this call decomposes into LEDGER_TERMS; the trainer reads it off
     # the context (or the Preempted exception) into Result.goodput
     ledger = GoodputLedger()
+    # unified telemetry (obs/): the attempt-scoped session the trainer
+    # (or a test/bench) configured; None = one is-None check per step.
+    # Per-step feed = host floats the loop already measures (iteration
+    # wall minus data wait minus eval/ckpt pauses) — no device sync,
+    # no event emission off the log cadence, so the A/B stream with
+    # obs on is bitwise-identical to obs off (tests pin that).
+    obs = obs_runtime.active()
+    if obs is not None and obs.capture is not None and profiler is not None:
+        # jax.profiler is process-global: the anomaly capture must not
+        # collide with the config-gated trace window
+        obs.capture._conflict = lambda: bool(
+            getattr(profiler, "active", False))
+    _obs_prev = [t_loop0, 0.0]   # [last note time, last eval/ckpt total]
     if guards is None:
         guards = RuntimeGuards.from_config()
     # KERNELCHECK=1 (analysis/kernelcheck.py): before anything trains,
@@ -178,6 +192,8 @@ def run_training(state: TrainState,
         if resumed is not None and is_host0:
             logger.info("resumed at step %d", resumed)
         resumed_step = resumed
+        if obs is not None and resumed is not None:
+            obs.emit("resume", step=resumed, resumed_step=resumed)
         # attempt metadata for Result.attempt_log (rayint/trainer.py);
         # context is stdlib-only, so this costs nothing standalone
         from gke_ray_train_tpu.rayint.context import get_context
@@ -223,6 +239,11 @@ def run_training(state: TrainState,
     def _preempt_exit(state, m, step):
         """Grace-window exit: force-save, wait until durable, raise the
         distinct 'preempted' status (train/preempt.py)."""
+        # flush TB FIRST: the grace window may end in SIGKILL mid-save,
+        # and a killed attempt's last scalars must already be on disk
+        # (the close() in the finally never runs under SIGKILL)
+        if tb_writer is not None:
+            tb_writer.flush()
         save_s = None
         if ckpt_manager is not None:
             t0 = time.perf_counter()
@@ -257,6 +278,10 @@ def run_training(state: TrainState,
         # change notice carries the surviving device count for the
         # trainer's elastic re-form
         ledger.close(time.perf_counter() - t_loop0)
+        if obs is not None:
+            obs.emit("preempt_exit", step=step, save_s=save_s,
+                     grace_remaining_s=preempt.remaining_grace_s(),
+                     pool=preempt.pool_target())
         raise preempt.Preempted(step=step, resumed_step=resumed_step,
                                 save_s=save_s, grace_s=preempt.grace_s(),
                                 pool=preempt.pool_target(),
@@ -340,6 +365,13 @@ def run_training(state: TrainState,
                         "fast_forward_s",
                         loop_timing["restart_to_first_step_s"]
                         - loop_timing["compile_s"] - ledger.restore_s)
+                if obs is not None:
+                    obs.emit("first_step", step=global_step + 1,
+                             compile_s=loop_timing["compile_s"],
+                             restart_to_first_step_s=loop_timing[
+                                 "restart_to_first_step_s"],
+                             restore_s=ledger.restore_s,
+                             fast_forward_s=ledger.fast_forward_s)
             else:
                 state, m = train_step(state, batch)
             global_step += 1
@@ -349,6 +381,24 @@ def run_training(state: TrainState,
                 heartbeat_fn(global_step)
             if profiler is not None:
                 profiler.step(global_step)
+            if obs is not None:
+                # anomaly detection feed (obs/capture.py): host
+                # iteration wall minus this batch's data wait and minus
+                # every ledgered non-step term booked since the last
+                # note (eval/ckpt pauses, and on the first step the
+                # restore/compile/fast-forward window) — under async
+                # dispatch, backpressure makes what remains track
+                # device step time. Pure host floats, no sync.
+                _now = time.perf_counter()
+                _booked = (ledger.eval_ckpt_stall_s + ledger.compile_s
+                           + ledger.restore_s + ledger.fast_forward_s)
+                obs.note_step(
+                    global_step,
+                    max(_now - _obs_prev[0] - wait_s
+                        - (_booked - _obs_prev[1]), 0.0),
+                    wait_s)
+                _obs_prev[0] = _now
+                _obs_prev[1] = _booked
             if meter is not None:
                 # tokens metric is device-resident; fetching it each step
                 # would sync — use the (static) batch token count instead
@@ -361,6 +411,12 @@ def run_training(state: TrainState,
                     last_metrics.update(meter.snapshot())
                 if tb_writer is not None:
                     tb_writer.log(global_step, last_metrics)
+                if obs is not None:
+                    # log-cadence telemetry sink: gauges + ONE `step`
+                    # event + file export, from the host dict already
+                    # fetched above — obs adds no device traffic
+                    obs.log_metrics(global_step, last_metrics,
+                                    epoch=epoch)
                 if is_host0:
                     logger.info(
                         "epoch %d step %d loss %.4f lr %.3g%s",
@@ -384,6 +440,9 @@ def run_training(state: TrainState,
                 last_metrics.update(eval_metrics)
                 if tb_writer is not None:
                     tb_writer.log(global_step, eval_metrics)
+                if obs is not None:
+                    obs.emit("eval", step=global_step,
+                             metrics=eval_metrics)
                 if is_host0:
                     logger.info("eval @ %d: %s", global_step, eval_metrics)
             # SAVE_STRATEGY="steps": mid-epoch checkpoints (HF save_steps
@@ -391,9 +450,14 @@ def run_training(state: TrainState,
             if ckpt_manager is not None and ckpt_every and \
                     global_step % ckpt_every == 0:
                 m_host = _fetch_metrics(m)
+                t_save0 = time.perf_counter()
                 with paused(meter), paused(ledger), allow_transfers():
                     ckpt_manager.save(global_step, save_view(state),
                                       metrics=m_host)
+                if obs is not None:
+                    obs.emit("ckpt_save", step=global_step,
+                             save_s=time.perf_counter() - t_save0,
+                             forced=False)
             if fault_injector is not None:
                 # after the step's bookkeeping AND its scheduled save, so
                 # kind=ckpt_truncate at step k tears the step-k save
@@ -433,6 +497,8 @@ def run_training(state: TrainState,
         if tb_writer is not None:
             tb_writer.log(global_step, epoch_metrics)
             tb_writer.flush()
+        if obs is not None:
+            obs.emit("epoch_end", step=global_step, epoch=epoch)
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
             with paused(ledger), allow_transfers():
@@ -447,6 +513,15 @@ def run_training(state: TrainState,
         ledger.close(time.perf_counter() - t_loop0)
         from gke_ray_train_tpu.rayint.context import get_context
         get_context().note_goodput(ledger.as_dict())
+        if obs is not None:
+            # ledger terms -> the obs registry, and the registry -> TB
+            # (train/tb.py log_registry): the dashboard, the Prometheus
+            # textfile and `obs report` all read the SAME decomposition
+            from gke_ray_train_tpu.train.metrics import ledger_metrics
+            obs.registry.set_many(ledger_metrics(ledger.as_dict()))
+            if tb_writer is not None:
+                tb_writer.log_registry(global_step, obs.registry)
+            obs.export()
         # leave the transfer-guard region before the post-loop export/
         # merge work — only the hot loop is guarded
         _guard_region.close()

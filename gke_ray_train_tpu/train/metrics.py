@@ -129,6 +129,20 @@ def sum_ledgers(ledgers) -> dict:
                              if total["wall_s"] > 0 else 0.0)
     return total
 
+
+def ledger_metrics(led: dict) -> dict:
+    """One ledger dict -> the ``goodput_*`` metric names the obs
+    registry and the TB writer publish (obs/metrics.py METRIC_NAMES
+    pins these — ONE mapping, so the dashboard scalars, the Prometheus
+    export and the report all read the identical decomposition)."""
+    out = {f"goodput_{t}": float(led.get(t, 0.0)) for t in LEDGER_TERMS}
+    if "wall_s" in led:
+        out["goodput_wall_s"] = float(led["wall_s"])
+        if led["wall_s"] > 0:
+            out["goodput_frac"] = float(led.get("step_s", 0.0)) \
+                / float(led["wall_s"])
+    return out
+
 # Peak dense bf16 TFLOP/s per chip, by device_kind substring.
 PEAK_FLOPS = {
     "v5 lite": 197e12,   # v5e (jax device_kind "TPU v5 lite")

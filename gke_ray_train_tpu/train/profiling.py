@@ -56,6 +56,14 @@ class TraceProfiler:
         self.start_step = v
 
     @property
+    def active(self) -> bool:
+        """Whether a trace is in flight RIGHT NOW. jax.profiler is
+        process-global, so the anomaly-capture scheduler
+        (obs/capture.py) checks this before arming its own one-shot
+        trace — two concurrent start_trace calls would fail both."""
+        return self._active
+
+    @property
     def stop_step(self) -> int:
         """Exclusive end of the trace window relative to this run's
         first step: traced steps are [start_step, stop_step)."""

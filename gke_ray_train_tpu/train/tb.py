@@ -48,6 +48,25 @@ class TensorBoardWriter:
                 continue
             self._w.add_scalar(k, float(v), global_step=step)
 
+    def log_registry(self, step: int, registry) -> None:
+        """Publish an obs MetricsRegistry snapshot (obs/metrics.py) as
+        ``obs/<name>`` scalars — the goodput ledger terms and the
+        serving latency/occupancy reach TensorBoard from the SAME
+        registry the Prometheus/JSON exporters read; there is no second
+        computation path to drift. Histograms publish their p50/p99."""
+        if self._w is None or registry is None:
+            return
+        snap = registry.snapshot()
+        snap.pop("labels", None)
+        flat = {}
+        for k, v in snap.items():
+            if isinstance(v, dict):          # histogram snapshot
+                flat[f"obs/{k}_p50"] = v.get("p50")
+                flat[f"obs/{k}_p99"] = v.get("p99")
+            else:
+                flat[f"obs/{k}"] = v
+        self.log(step, {k: v for k, v in flat.items() if v is not None})
+
     def flush(self) -> None:
         if self._w is not None:
             self._w.flush()

@@ -426,6 +426,11 @@ def train_loop_per_worker(config: dict):
         from gke_ray_train_tpu.data import save_tokenizer
         save_tokenizer(tokenizer, final_dir)
         logger.info("saved final model + tokenizer to %s", final_dir)
+        # obs: exports are run events too — `obs report` shows what
+        # artifacts the run produced and when (no-op when obs is off)
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        obs_runtime.emit("export", path=final_dir,
+                         what="merged" if use_lora else "full")
     elif n_hosts > 1:
         if use_lora:
             # sharded across hosts: each device holds 1/N of the
@@ -549,6 +554,19 @@ def train_loop_per_worker(config: dict):
                 with open(os.path.join(out_base, "serve_smoke.json"),
                           "w") as f:
                     json.dump(stats, f, indent=2)
+                # serving latency/occupancy -> TB, via the SAME obs
+                # registry the engine exported into (train/tb.py
+                # log_registry; the loop's writer is closed by now, so
+                # a short-lived one publishes the post-train scalars)
+                from gke_ray_train_tpu.obs import runtime as obs_runtime
+                if obs_runtime.registry() is not None:
+                    w = writer_from_config(
+                        config, os.path.join(out_base, "tensorboard"),
+                        is_host0=True)
+                    if w is not None:
+                        w.log_registry(int(jax.device_get(state.step)),
+                                       obs_runtime.registry())
+                        w.close()
     return metrics
 
 
@@ -602,6 +620,13 @@ if __name__ == "__main__":
         sys.exit(1)
     logger.info("final metrics: %s (attempts=%d preemptions=%d)",
                 result.metrics, result.attempts, result.preemptions)
+    # unified telemetry (obs/): point the operator at the one merged
+    # per-run view of what just happened
+    from gke_ray_train_tpu.obs.runtime import resolve_obs_dir
+    _obs_dir = resolve_obs_dir(None, config)
+    if _obs_dir is not None:
+        logger.info("run telemetry: python -m gke_ray_train_tpu.obs "
+                    "report %s --text", _obs_dir)
     # one machine-readable line on stdout (logging goes to stderr) so
     # drivers/scripts (scripts/record_baselines.sh) can collect the
     # job's meter numbers the same way they collect bench.py records

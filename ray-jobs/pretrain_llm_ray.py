@@ -218,6 +218,11 @@ def train_loop_per_worker(config: dict):
                     logger.info("serve smoke %s: %r", c.rid,
                                 tok.decode(np.asarray(c.generated)))
                 ctx.report({**metrics, "serve_smoke": stats})
+    # obs: record the run's durable artifact (checkpoints + tokenizer
+    # dir) as an event; the obs dir itself defaults to
+    # <storage_path>/<run_name>/obs for this entry (obs/runtime.py)
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
+    obs_runtime.emit("export", path=run_dir, what="checkpoint")
     return metrics
 
 
@@ -283,3 +288,9 @@ if __name__ == "__main__":
         sys.exit(1)
     logger.info("final metrics: %s (attempts=%d preemptions=%d)",
                 result.metrics, result.attempts, result.preemptions)
+    # unified telemetry (obs/): the one merged per-run view
+    from gke_ray_train_tpu.obs.runtime import resolve_obs_dir
+    _obs_dir = resolve_obs_dir(None, train_loop_config)
+    if _obs_dir is not None:
+        logger.info("run telemetry: python -m gke_ray_train_tpu.obs "
+                    "report %s --text", _obs_dir)

@@ -53,8 +53,18 @@ run compile        env BENCH_MODE=compile python bench.py
 # itself there): injected pool shrink 8->4->8, mesh re-formed and the
 # checkpoint resumed RESHARDED at each change; the record carries the
 # goodput ledger, time-to-first-step-after-shrink, and the per-attempt
-# shrink/grow classification + plan fingerprints
-run elastic        env BENCH_MODE=elastic python bench.py
+# shrink/grow classification + plan fingerprints. OBS_DIR routes the
+# run's full telemetry (per-rank events, metric exports, the bench
+# record itself) into one dir...
+OBS_ELASTIC_DIR="$(mktemp -d /tmp/obs_elastic.XXXXXX)"
+run elastic        env BENCH_MODE=elastic OBS_DIR="$OBS_ELASTIC_DIR" python bench.py
+
+# ...which `obs report` (gke_ray_train_tpu/obs) merges into ONE
+# reconciled per-run artifact: per-attempt timeline (both reshards),
+# goodput ledger terms summing to attempt wall-clock exactly, anomaly/
+# capture inventory, and the bench record — report.json stays beside
+# the events, the summary line lands in $OUT
+run obs-report     python -m gke_ray_train_tpu.obs report "$OBS_ELASTIC_DIR"
 
 # compile-cost budgets (tests/budgets/*.json) are recorded on the
 # canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI

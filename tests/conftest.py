@@ -26,6 +26,12 @@ os.environ.setdefault("RETRY_BACKOFF_S", "0")
 # measurements on the same machine. Tests that WANT the cache (
 # tests/test_perf.py) re-enable it into a sandbox dir explicitly.
 os.environ.setdefault("COMPILE_CACHE", "0")
+# obs telemetry (obs/) defaults ON for runs with an output dir; under
+# the suite that would write event/metric streams into every tmpdir
+# and — worse — arm anomaly-triggered jax.profiler captures whose
+# first start_trace costs tens of seconds on some hosts. Tests that
+# WANT telemetry (tests/test_obs.py) opt back in via config/obs_dir.
+os.environ.setdefault("OBS", "0")
 
 import jax  # noqa: E402
 

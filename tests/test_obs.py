@@ -1,0 +1,712 @@
+"""obs/ — unified run telemetry (ISSUE 11).
+
+Contract tests in the style of test_bench_contract: the event/metric
+schema is PINNED (shipped schema files == code vocabularies), the
+anomaly-capture drill proves fire-once semantics on the CPU mesh with
+injected faults, `obs report` over the elastic 8->4->8 drill shows both
+reshards with every attempt's ledger reconciling to its wall-clock
+exactly, and the hot-path guarantee is asserted the strong way: the
+loss stream with obs enabled is BITWISE-identical to obs off.
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.obs import events as obs_events
+from gke_ray_train_tpu.obs import metrics as obs_metrics
+from gke_ray_train_tpu.obs import runtime as obs_runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_session(monkeypatch):
+    """Every test starts with no active obs session and fresh identity
+    env (the suite-wide OBS=0 from conftest stays in force unless a
+    test opts in explicitly)."""
+    obs_runtime.end_attempt("test-cleanup")
+    monkeypatch.delenv("OBS_RUN_ID", raising=False)
+    monkeypatch.delenv("OBS_ATTEMPT", raising=False)
+    monkeypatch.delenv("OBS_DIR", raising=False)
+    yield
+    obs_runtime.end_attempt("test-cleanup")
+
+
+# ---------------------------------------------------------------------------
+# schema contracts
+# ---------------------------------------------------------------------------
+
+def test_event_schema_pinned():
+    # shipped file == code vocabulary, both directions
+    assert obs_events.check_schema() == []
+    # the stamp is the cross-artifact correlation contract
+    assert obs_events.STAMP_FIELDS == (
+        "ts", "run_id", "attempt", "rank", "slice", "step",
+        "plan_fingerprint", "kind")
+    # closed vocabulary: unknown kinds and stray payload fields raise
+    with pytest.raises(obs_events.EventError):
+        obs_events.validate_event("made_up_kind", {})
+    with pytest.raises(obs_events.EventError):
+        obs_events.validate_event("resume", {"stray_field": 1})
+    obs_events.validate_event("resume", {"resumed_step": 4})
+
+
+def test_metric_schema_pinned():
+    assert obs_metrics.check_schema() == []
+    reg = obs_metrics.MetricsRegistry()
+    with pytest.raises(obs_metrics.MetricError):
+        reg.counter("made_up_metric")
+    with pytest.raises(obs_metrics.MetricError):
+        reg.counter("loss")        # declared a gauge
+    # goodput_* mirror the ledger terms exactly (one source)
+    from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+    assert {f"goodput_{t}" for t in LEDGER_TERMS} | \
+        {"goodput_wall_s", "goodput_frac"} == \
+        {k for k in obs_metrics.METRIC_NAMES if k.startswith("goodput_")}
+    # report's jax-free term list cannot drift from the ledger either
+    from gke_ray_train_tpu.obs.report import LEDGER_TERMS as REPORT_TERMS
+    assert tuple(REPORT_TERMS) == LEDGER_TERMS
+
+
+def test_registry_exports(tmp_path):
+    reg = obs_metrics.MetricsRegistry(labels={"run_id": "r1", "rank": "0"})
+    reg.counter("steps_total").inc(3)
+    reg.gauge("loss").set(1.25)
+    for v in (0.01, 0.02, 0.5):
+        reg.histogram("step_time_s").observe(v)
+    reg.set_many({"mfu": 0.4, "not_a_metric": 9.9, "loss": float("nan")})
+    snap = reg.snapshot()
+    assert snap["steps_total"] == 3 and snap["mfu"] == 0.4
+    assert "not_a_metric" not in snap
+    assert snap["loss"] == 1.25          # NaN set_many is dropped
+    assert snap["step_time_s"]["count"] == 3
+    assert snap["step_time_s"]["p99"] == 0.5
+    paths = reg.export(str(tmp_path), 0)
+    doc = json.load(open(paths[".json"]))
+    assert set(doc) - {"labels"} <= set(obs_metrics.METRIC_NAMES)
+    prom = open(paths[".prom"]).read()
+    assert '# TYPE grt_loss gauge' in prom
+    assert 'grt_loss{rank="0",run_id="r1"} 1.25' in prom
+    assert 'grt_steps_total{rank="0",run_id="r1"} 3' in prom
+    assert 'quantile="0.99"' in prom
+
+
+def test_configure_run_logging_prefix(capsys):
+    from gke_ray_train_tpu.logging_utils import configure_run_logging
+    root = logging.getLogger()
+    h = logging.Handler()
+    records = []
+    h.emit = lambda rec: records.append(rec.getMessage())
+    root.addHandler(h)
+    try:
+        configure_run_logging("abc123", 2, 1)
+        logging.getLogger("some.module").warning("hello %d", 7)
+        # re-arm with a new attempt: the old filter is REPLACED
+        configure_run_logging("abc123", 3, 1)
+        logging.getLogger("some.module").warning("again")
+    finally:
+        root.removeHandler(h)
+    assert records[0] == "[run=abc123 a2 r1] hello 7"
+    assert records[1] == "[run=abc123 a3 r1] again"
+
+
+# ---------------------------------------------------------------------------
+# loop integration: bitwise A/B + anomaly-capture drill
+# ---------------------------------------------------------------------------
+
+def _tiny_setup():
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    cfg = tiny(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    step = make_train_step(cfg, opt, donate=False)
+    return cfg, opt, state, step
+
+
+def _batches(steps, B=2, S=16, vocab=128, hook=None):
+    def gen(epoch):
+        for i in range(steps):
+            if hook is not None:
+                hook(i)
+            k = jax.random.key(i)
+            yield {"inputs": jax.random.randint(k, (B, S), 0, vocab),
+                   "targets": jax.random.randint(k, (B, S), 0, vocab),
+                   "weights": jnp.ones((B, S), jnp.float32)}
+    return gen
+
+
+def test_obs_off_hot_path_bitwise(tmp_path):
+    """The acceptance gate: the loss stream with obs fully enabled is
+    BITWISE-identical to obs off — telemetry adds no device traffic
+    and perturbs no numerics."""
+    from gke_ray_train_tpu.train.loop import run_training
+
+    def run(with_obs):
+        _, _, state, step = _tiny_setup()
+        if with_obs:
+            obs_runtime.start_attempt(
+                obs_dir=str(tmp_path / "obs_on"))
+        try:
+            final, m = run_training(state, step, _batches(8), epochs=1,
+                                    log_every=2)
+        finally:
+            obs_runtime.end_attempt("ok")
+        return float(m["loss"]), jax.device_get(final.params)
+
+    loss_off, params_off = run(False)
+    loss_on, params_on = run(True)
+    assert loss_on == loss_off          # bitwise, not approx
+    flat_off = jax.tree_util.tree_leaves(params_off)
+    flat_on = jax.tree_util.tree_leaves(params_on)
+    assert all(np.array_equal(a, b) for a, b in zip(flat_on, flat_off))
+    # and the enabled run actually produced telemetry
+    evs = [json.loads(line) for line in
+           open(tmp_path / "obs_on" / "events-r0.jsonl")]
+    assert {"step", "worker_exit"} <= {e["kind"] for e in evs}
+
+
+def test_anomaly_capture_fire_once(tmp_path):
+    """The drill the ISSUE names: injected data stall + injected
+    mid-run recompile on the CPU mesh; each anomaly class fires
+    exactly ONE capture with a real artifact, and a second stall does
+    not re-fire."""
+    from gke_ray_train_tpu.train.loop import run_training
+    _, _, state, step = _tiny_setup()
+    steps = 26
+    STALLS, COMPILE_AT = (12, 18), 22
+
+    def hook(i):
+        if i in STALLS:
+            time.sleep(0.35)                      # input-pipeline stall
+        if i == COMPILE_AT:
+            jax.jit(lambda x: x * 3)(jnp.ones(()))  # mid-run compile
+
+    run = obs_runtime.start_attempt(obs_dir=str(tmp_path))
+    assert run is not None and run.capture is not None
+    try:
+        run_training(state, step, _batches(steps, hook=hook), epochs=1,
+                     log_every=5)
+    finally:
+        obs_runtime.end_attempt("ok")
+
+    evs = [json.loads(line) for line in open(tmp_path / "events-r0.jsonl")]
+    anomalies = [e for e in evs if e["kind"] == "anomaly"]
+    captures = [e for e in evs if e["kind"] == "capture"]
+    by_class = {}
+    for a in anomalies:
+        by_class.setdefault(a["class"], []).append(a)
+    # fire-once: ONE anomaly per class despite two injected stalls
+    assert len(by_class.get("data_stall", [])) == 1
+    assert len(by_class.get("recompile", [])) == 1
+    cap_classes = sorted(c["class"] for c in captures)
+    assert cap_classes.count("data_stall") == 1
+    assert cap_classes.count("recompile") == 1
+    for c in captures:
+        assert not c["failed"]
+        marker = os.path.join(c["artifact"], "capture.json")
+        assert os.path.exists(marker), c
+        doc = json.load(open(marker))
+        assert doc["class"] == c["class"]
+    # counters agree with the event stream
+    mx = json.load(open(tmp_path / "metrics-r0.json"))
+    assert mx["anomalies_total"] == len(anomalies)
+    assert mx["captures_total"] == len(captures)
+    assert mx["steps_total"] == steps
+    assert mx["backend_compiles_total"] > 0
+
+
+def test_capture_budget_and_trace_conflict(tmp_path):
+    """Budget 0 = detection without captures; an external in-flight
+    trace defers arming (jax.profiler is process-global)."""
+    from gke_ray_train_tpu.obs.capture import CaptureManager
+    emitted = []
+    cm = CaptureManager(str(tmp_path), emit_fn=lambda k, **kw:
+                        emitted.append((k, kw)), budget=0,
+                        warmup_steps=2)
+    for i in range(3):
+        cm.note_step(i, 0.001, 0.0)
+    cm.note_step(3, 0.001, 5.0)      # stall, but budget is 0
+    for i in range(4, 8):
+        cm.note_step(i, 0.001, 0.0)
+    kinds = [k for k, _ in emitted]
+    assert kinds.count("anomaly") == 1 and "capture" not in kinds
+
+    cm2 = CaptureManager(str(tmp_path / "c2"), emit_fn=lambda k, **kw:
+                         emitted.append((k, kw)), budget=2,
+                         warmup_steps=2,
+                         trace_conflict=lambda: True)
+    for i in range(3):
+        cm2.note_step(i, 0.001, 0.0)
+    cm2.note_step(3, 0.001, 5.0)
+    for i in range(4, 10):
+        cm2.note_step(i, 0.001, 0.0)
+    # anomaly recorded, but the conflicting trace kept the capture
+    # pending the whole run — nothing started
+    assert cm2._active is None and not cm2.captured
+
+
+# ---------------------------------------------------------------------------
+# supervisor satellite
+# ---------------------------------------------------------------------------
+
+def test_supervisor_metrics_view_names_stalled_rank(tmp_path):
+    from gke_ray_train_tpu.rayint.supervisor import HeartbeatBoard
+    board = HeartbeatBoard()
+    board.set_slices({0: 0, 1: 1})
+    board.beat(0, 5)
+    board.beat(1, 5)
+    board.beat(0, 6)                 # rank 1 stops progressing
+    time.sleep(0.05)
+    board.beat(0, 7)                 # rank 0 keeps beating
+    view = board.metrics_view(timeout_s=0.02)
+    assert set(view["ranks"]) == {"0", "1"}
+    assert view["ranks"]["1"]["slice"] == 1
+    stalled_ranks = [s["rank"] for s in view["stalled"]]
+    assert stalled_ranks == [1]      # rank 1 named, rank 0 fresh... ish
+    # the driver-side exporter writes it where `obs report` reads it
+    drv = obs_runtime.DriverObs(str(tmp_path), "runX")
+    drv.export_supervisor(view)
+    drv.close()
+    doc = json.load(open(tmp_path / "supervisor.json"))
+    assert doc["stalled"][0]["rank"] == 1
+    assert doc["ranks"]["1"]["step"] == 5
+
+
+def test_watchdog_pre_interrupt_hook_fires():
+    from gke_ray_train_tpu.rayint.supervisor import HeartbeatBoard, Watchdog
+    board = HeartbeatBoard()
+    board.beat(0, 1)
+    seen = []
+    wd = Watchdog(board, timeout_s=0.05, poll_s=0.02,
+                  on_stall=lambda stalled: seen.append(("kill", stalled)),
+                  pre_interrupt=lambda stalled: seen.append(("pre", stalled)))
+    wd.start()
+    time.sleep(0.4)
+    wd.stop()
+    assert [tag for tag, _ in seen] == ["pre", "kill"]
+
+
+# ---------------------------------------------------------------------------
+# tb satellite
+# ---------------------------------------------------------------------------
+
+class _StubWriter:
+    """Duck-typed tb writer recording calls (no TB backend needed)."""
+
+    def __init__(self):
+        self.scalars = {}
+        self.flushes = 0
+        self.closed = False
+        self._w = True       # satisfies TensorBoardWriter.log_registry
+
+    def log(self, step, metrics):
+        for k, v in metrics.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                self.scalars[k] = float(v)
+
+    def log_registry(self, step, registry):
+        from gke_ray_train_tpu.train.tb import TensorBoardWriter
+        TensorBoardWriter.log_registry(self, step, registry)
+
+    def flush(self):
+        self.flushes += 1
+
+    def close(self):
+        self.closed = True
+
+
+def test_tb_flush_on_preempt_and_ledger_scalars(tmp_path):
+    """The satellite fix: a preempted attempt flushes its scalars
+    BEFORE the grace-window save (SIGKILL-proof), and the goodput
+    ledger reaches TB from the obs registry — no second computation."""
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.testing.faults import (
+        FaultInjector, parse_fault_spec, reset_fired)
+    from gke_ray_train_tpu.train import preempt
+    from gke_ray_train_tpu.train.loop import run_training
+    from gke_ray_train_tpu.train.preempt import Preempted
+    _, _, state, step = _tiny_setup()
+    reset_fired()
+    preempt.reset()
+    w = _StubWriter()
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2,
+                            score_attribute=None, async_save=False)
+    inj = FaultInjector(parse_fault_spec("rank=0:kind=sigterm:step=4"),
+                        rank=0, ckpt_manager=mgr)
+    obs_runtime.start_attempt(obs_dir=str(tmp_path / "obs"))
+    try:
+        with pytest.raises(Preempted):
+            run_training(state, step, _batches(8), epochs=1,
+                         ckpt_manager=mgr, fault_injector=inj,
+                         tb_writer=w, log_every=2)
+    finally:
+        mgr.close()
+        preempt.reset()
+        preempt.uninstall()
+        obs_runtime.end_attempt("preempted")
+    assert w.flushes >= 1            # flushed at the preempt boundary
+    assert w.closed                  # and still closed by the finally
+    # ledger terms arrived as obs/goodput_* scalars via log_registry
+    assert "obs/goodput_step_s" in w.scalars
+    assert "obs/goodput_compile_s" in w.scalars
+    assert w.scalars["obs/steps_total"] == 4
+
+
+# ---------------------------------------------------------------------------
+# plan knobs
+# ---------------------------------------------------------------------------
+
+def test_obs_plan_knobs_three_dialects():
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    via_json = ExecutionPlan.from_config(
+        {"OBS": False, "OBS_DIR": "/x/obs", "OBS_CAPTURE": 0,
+         "OBS_CAPTURE_BUDGET": 7})
+    via_env = ExecutionPlan.from_env(
+        {"OBS": "false", "OBS_DIR": "/x/obs", "OBS_CAPTURE": "off",
+         "OBS_CAPTURE_BUDGET": "7"})
+    via_kw = ExecutionPlan.from_kwargs(obs=False, obs_dir="/x/obs",
+                                       obs_capture=False,
+                                       obs_capture_budget=7)
+    assert via_json == via_env == via_kw
+    assert via_json.fingerprint() == via_kw.fingerprint()
+    # telemetry knobs are OPERATIONAL: they must never stale a compiled
+    # artifact on either surface
+    base = ExecutionPlan()
+    toggled = ExecutionPlan.from_kwargs(obs=False, obs_capture_budget=9)
+    for surface in ("train", "serve", "all"):
+        assert base.compile_fingerprint(surface) == \
+            toggled.compile_fingerprint(surface)
+    # obs_dir is RUN-scoped (record_baselines points it at mktemp):
+    # two runs of the byte-identical plan must share a fingerprint
+    assert ExecutionPlan.from_kwargs(obs_dir="/tmp/a").fingerprint() \
+        == ExecutionPlan.from_kwargs(obs_dir="/tmp/b").fingerprint() \
+        == base.fingerprint()
+    with pytest.raises(Exception):
+        ExecutionPlan.from_kwargs(obs_capture_budget=-1)
+
+
+def test_resolve_obs_dir_precedence(monkeypatch):
+    from gke_ray_train_tpu.obs.runtime import resolve_obs_dir
+    monkeypatch.setenv("OBS", "1")
+    assert resolve_obs_dir(None, {"OBS_DIR": "/d"}) == "/d"
+    assert resolve_obs_dir(None, {"OUTPUT_DIR_BASE": "/o"}) == "/o/obs"
+    assert resolve_obs_dir(
+        None, {"storage_path": "/s", "run_name": "r"}) == "/s/r/obs"
+    assert resolve_obs_dir(None, {}) is None
+    assert resolve_obs_dir(None, {"OBS": "0", "OBS_DIR": "/d"}) is None
+
+
+# ---------------------------------------------------------------------------
+# the elastic drill: events + report + reconciliation + CLI
+# ---------------------------------------------------------------------------
+
+def _elastic_drill(work):
+    """The BENCH_MODE=elastic shape (8->4->8 injected pool change
+    through the real trainer) with obs enabled — shared by the report
+    tests below."""
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.parallel.placement import make_place_batch
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.rayint import (
+        FailureConfig, JaxTrainer, RunConfig)
+    from gke_ray_train_tpu.rayint.elastic import maybe_replan
+    from gke_ray_train_tpu.testing.faults import (
+        FaultInjector, parse_fault_spec, reset_fired, reset_pool)
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    from gke_ray_train_tpu.train.loop import run_training
+
+    cfg = tiny(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    steps, shrink, grow, ck = 10, 4, 7, 2
+    B, S = 8, 16
+    obs_dir = os.path.join(work, "obs")
+    config = {"MESH_DATA": 1, "MESH_FSDP": -1,
+              "PER_DEVICE_TRAIN_BATCH_SIZE": 1, "MAX_SEQ_LENGTH": S,
+              "TOPOLOGY": "cpu-8", "ELASTIC": "1",
+              "OBS": "1", "OBS_DIR": obs_dir, "OBS_CAPTURE": "0"}
+
+    def batches(epoch):
+        for i in range(steps):
+            rng = np.random.default_rng(epoch * 1000 + i)
+            yield {"inputs": rng.integers(0, 128, (B, S)).astype(np.int32),
+                   "targets": rng.integers(0, 128, (B, S)).astype(np.int32),
+                   "weights": np.ones((B, S), np.float32)}
+
+    def worker(c):
+        plan, devs = maybe_replan(ExecutionPlan.resolve(c), config=c)
+        mesh = plan.build_mesh(devs)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step_fn = make_train_step(cfg, opt, mesh=mesh, donate=False)
+        mgr = CheckpointManager(os.path.join(work, "ckpt"),
+                                max_to_keep=2, score_attribute=None,
+                                async_save=False)
+        inj = FaultInjector(parse_fault_spec(
+            f"rank=0:kind=pool_shrink:to=4:step={shrink};"
+            f"rank=0:kind=pool_shrink:to=8:step={grow}"),
+            rank=0, ckpt_manager=mgr)
+        try:
+            final, _m = run_training(
+                state, step_fn, batches, epochs=1, ckpt_manager=mgr,
+                ckpt_every=ck, log_every=2,
+                place_batch=make_place_batch(mesh), fault_injector=inj)
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step))}
+
+    reset_fired()
+    reset_pool()
+    try:
+        res = JaxTrainer(
+            worker, train_loop_config=config, use_ray=False,
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=0,
+                                             max_preemptions=4),
+                retry_backoff_s=0.0)).fit()
+    finally:
+        reset_pool()
+    assert res.error is None and res.metrics["final_step"] == steps, res
+    return obs_dir, res
+
+
+def test_obs_report_elastic_drill(tmp_path):
+    """The acceptance drill: a CPU-mesh run with injected pool_shrink
+    events produces ONE report in which (a) every attempt's ledger
+    terms sum to its wall-clock exactly, (b) both reshards (8->4 and
+    4->8) appear on the attempt timelines, and (c) the per-attempt
+    events classify shrink/grow as preemptions."""
+    from gke_ray_train_tpu.obs.report import build_report
+    obs_dir, res = _elastic_drill(str(tmp_path))
+    rep = build_report(str(tmp_path))       # parent dir also accepted
+    assert rep["n_attempts"] == res.attempts == 3
+    assert rep["reconciled"] is True
+    for a in rep["attempts"]:
+        rec = a["reconciliation"]
+        assert rec is not None and rec["ok"], a
+        # exact identity, not approximate: lost_s was constructed as
+        # the attempt-wall residual
+        assert abs(rec["residual_s"]) <= 1e-6 * max(1.0, rec["wall_s"])
+    assert [a.get("event") for a in rep["attempts"]] == \
+        ["shrink", "grow", None]
+    pairs = [(r["from_devices"], r["to_devices"])
+             for a in rep["attempts"] for r in a.get("reshard", [])]
+    assert (8, 4) in pairs and (4, 8) in pairs     # BOTH reshards
+    # every record of every stream carries the same run id
+    run_ids = {e.get("run_id")
+               for e in obs_events.iter_events(obs_dir)}
+    assert len(run_ids) == 1
+    # the driver's summed ledger matches the trainer's Result
+    assert abs(rep["goodput"]["wall_s"] - res.goodput["wall_s"]) < 1e-6
+
+
+def test_terminal_pool_failure_attempt_still_reported(tmp_path):
+    """A shrink below MIN_DEVICES ends the run from inside
+    classify_pool — the terminal attempt must still get its
+    attempt_end BEFORE run_end closes the driver stream, so the
+    report shows the refusing-to-re-form attempt."""
+    from gke_ray_train_tpu.ckpt import CheckpointManager
+    from gke_ray_train_tpu.obs.report import build_report
+    from gke_ray_train_tpu.rayint import (
+        FailureConfig, JaxTrainer, RunConfig)
+    from gke_ray_train_tpu.testing.faults import (
+        FaultInjector, parse_fault_spec, reset_fired, reset_pool)
+    from gke_ray_train_tpu.train.loop import run_training
+    _, _, state, step = _tiny_setup()
+    obs_dir = str(tmp_path / "obs")
+    config = {"ELASTIC": "1", "MIN_DEVICES": "6",
+              "OBS": "1", "OBS_DIR": obs_dir, "OBS_CAPTURE": "0"}
+
+    def worker(c):
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2,
+                                score_attribute=None, async_save=False)
+        inj = FaultInjector(
+            parse_fault_spec("rank=0:kind=pool_shrink:to=4:step=3"),
+            rank=0, ckpt_manager=mgr)
+        try:
+            run_training(state, step, _batches(6), epochs=1,
+                         ckpt_manager=mgr, ckpt_every=2,
+                         fault_injector=inj)
+        finally:
+            mgr.close()
+        return {}
+
+    from gke_ray_train_tpu.train import preempt
+    reset_fired()
+    reset_pool()
+    try:
+        res = JaxTrainer(
+            worker, train_loop_config=config, use_ray=False,
+            run_config=RunConfig(
+                failure_config=FailureConfig(max_failures=0,
+                                             max_preemptions=4),
+                retry_backoff_s=0.0)).fit()
+    finally:
+        reset_pool()
+        # the run ENDS preempted-with-flag-up (no further attempt
+        # resets it) — clear it or later tests in this process
+        # preempt-exit at step 0 (the bench_recovery convention)
+        preempt.reset()
+        preempt.uninstall()
+    assert res.status == "failed" and "MIN_DEVICES" in res.error
+    rep = build_report(obs_dir)
+    assert rep["n_attempts"] == len(res.attempt_log) == 1
+    assert rep["attempts"][0]["status"] == "failed"
+    assert rep["reconciled"] is True
+    # run_end is the LAST driver record, after the terminal attempt_end
+    kinds = [e["kind"] for e in obs_events.iter_events(obs_dir)
+             if e.get("rank") == "driver"]
+    assert kinds[-1] == "run_end" and "attempt_end" in kinds
+
+
+def test_obs_report_cli_contract(tmp_path):
+    """rc contract (pinned like the analysis CLIs): 0 = report written
+    + ONE JSON summary line on stdout; 1 = no telemetry; 2 = usage;
+    plus the schema verb."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "report", str(tmp_path / "nothing_here")],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1, r.stderr
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "schema"], capture_output=True, text=True,
+                       env=env)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip())["ok"] is True
+
+    # a real (tiny, no-trainer) run dir: one summary line, rc 0
+    run = obs_runtime.start_attempt(obs_dir=str(tmp_path / "obs"))
+    run.emit("attempt_start", topology="cpu-8", n_devices=8)
+    run.note_step(1, 0.001, 0.0)
+    obs_runtime.end_attempt("ok")
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "report", str(tmp_path / "obs"), "--text"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    summary = json.loads(lines[0])
+    assert summary["unit"] == "attempts" and summary["reconciled"]
+    assert os.path.exists(summary["report"])
+    assert "obs report" in r.stderr      # --text timeline on stderr
+
+
+def test_report_driverless_multirank_one_attempt(tmp_path):
+    """A driverless multi-process session writes one worker_exit per
+    RANK; the report must still count one attempt (not world-size) and
+    must not multiply the goodput totals."""
+    from gke_ray_train_tpu.obs.events import EventLog, events_path
+    from gke_ray_train_tpu.obs.report import build_report
+    led = {"compile_s": 1.0, "step_s": 3.0, "wall_s": 4.0}
+    for rank in (0, 1, 2):
+        log = EventLog(events_path(str(tmp_path), rank), run_id="r",
+                       attempt=1, rank=rank)
+        log.emit("worker_exit", status="ok", goodput=led)
+        log.close()
+    rep = build_report(str(tmp_path))
+    assert rep["n_attempts"] == 1
+    assert rep["goodput"]["wall_s"] == 4.0          # not 12.0
+
+
+def test_capture_start_failure_reported_failed(tmp_path, monkeypatch):
+    """A capture whose start_trace failed must be emitted with
+    failed=True — an operator must never be pointed at an empty
+    artifact as good evidence."""
+    import jax
+
+    from gke_ray_train_tpu.obs.capture import CaptureManager
+
+    def boom(*a, **k):
+        raise RuntimeError("profiler busy")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    emitted = []
+    cm = CaptureManager(str(tmp_path), emit_fn=lambda k, **kw:
+                        emitted.append((k, kw)), budget=2,
+                        warmup_steps=2)
+    for i in range(3):
+        cm.note_step(i, 0.001, 0.0)
+    cm.note_step(3, 0.001, 5.0)          # stall -> arm capture
+    for i in range(4, 10):
+        cm.note_step(i, 0.001, 0.0)
+    cm.close()
+    caps = [kw for k, kw in emitted if k == "capture"]
+    assert caps and caps[0]["failed"] is True
+
+
+def test_report_rejects_unreconciled(tmp_path):
+    """A doctored ledger (terms != wall) must flip the report to
+    un-reconciled and the CLI to rc 3 — the invariant has teeth."""
+    drv = obs_runtime.DriverObs(str(tmp_path), "runY")
+    bad = {t: 0.0 for t in
+           ("compile_s", "restore_s", "fast_forward_s", "data_stall_s",
+            "eval_ckpt_stall_s", "step_s", "lost_s")}
+    bad.update(step_s=1.0, wall_s=9.0)      # terms sum 1.0 != wall 9.0
+    drv.note_attempt(1, {"status": "ok", "goodput": bad})
+    drv.close()
+    from gke_ray_train_tpu.obs.report import build_report
+    rep = build_report(str(tmp_path))
+    assert rep["reconciled"] is False
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "report", str(tmp_path)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 3
+
+
+# ---------------------------------------------------------------------------
+# serve engine integration
+# ---------------------------------------------------------------------------
+
+def test_serve_engine_exports_obs(tmp_path):
+    """run_until_drained lands serve_start/serve_drained on the event
+    stream and the p50/p99/occupancy numbers in the metric export —
+    the same stats() dict BENCH_MODE=serve pins."""
+    import dataclasses
+
+    from gke_ray_train_tpu.models import init_params, llama3_8b
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.serve.engine import BatchEngine, Request
+    cfg = dataclasses.replace(
+        llama3_8b(), name="obs-serve-test", d_model=64, n_layers=1,
+        n_heads=2, n_kv_heads=2, d_ff=128, vocab_size=128,
+        max_seq_len=64, dtype="float32", param_dtype="float32",
+        remat=False)
+    plan = ExecutionPlan.from_kwargs(max_batch=2, decode_buckets="64",
+                                     aot_train_step=False)
+    params = init_params(cfg, jax.random.key(0))
+    obs_runtime.start_attempt(obs_dir=str(tmp_path))
+    try:
+        engine = BatchEngine(params, cfg, plan=plan, eos_ids=())
+        comps = engine.run_until_drained([
+            Request(rid=f"r{i}",
+                    token_ids=np.arange(3, 9, dtype=np.int32),
+                    max_new_tokens=4) for i in range(3)])
+    finally:
+        obs_runtime.end_attempt("ok")
+    assert len(comps) == 3
+    evs = [json.loads(line) for line in open(tmp_path / "events-r0.jsonl")]
+    drained = [e for e in evs if e["kind"] == "serve_drained"]
+    assert drained and drained[-1]["stats"]["completed"] == 3
+    mx = json.load(open(tmp_path / "metrics-r0.json"))
+    assert mx["serve_completed_total"] == 3
+    assert mx["serve_batch_occupancy"] > 0
+    assert mx["serve_p50_token_latency_s"] >= 0
