@@ -39,7 +39,11 @@ shrink/grow event classification),
 ``compile`` (compile-once layer A/B, perf/: cold build vs warm
 persistent-cache build vs deserialized AOT executable, plus the
 compile-level StepCostReport — meaningful on ANY backend, including
-the CPU mesh).
+the CPU mesh),
+``overlap`` (OVERLAP=off vs =manual A/B through make_train_step:
+bitwise-identical loss streams asserted, per-arm tokens/sec and the
+scheduled-HLO overlap evidence — overlap_frac / exposed collective
+bytes — on one record; the cost-model half survives a dead backend).
 
 Dead-accelerator behavior: when the backend probe fails, the bench
 re-execs itself on the 8-fake-device CPU mesh and still emits a VALID
@@ -1038,6 +1042,104 @@ def bench_compile():
         compare_baseline=False)
 
 
+def bench_overlap():
+    """BENCH_MODE=overlap: off-vs-on A/B of the overlap execution path
+    (ROADMAP #3, plan knob ``OVERLAP``). Both arms run the SAME model,
+    init and batch stream through ``make_train_step``; the only delta
+    is the plan's overlap mode — ``off`` (the GSPMD scan) vs ``manual``
+    (the shard_map pipeline that double-buffers the per-layer FSDP
+    all-gather, train/overlap.py). The record asserts the two loss
+    streams are BITWISE-identical (the equivalence the manual path is
+    built on) and carries each arm's compile-level overlap evidence —
+    ``overlap_frac`` / ``exposed_collective_bytes`` from the scheduled
+    HLO — which is the half of the claim that survives the dead
+    accelerator backend. value = manual/off tokens-per-second ratio
+    (on the CPU mesh the interesting number is the exposure delta, not
+    wall-clock; shard_map adds trace overhead XLA:TPU amortizes)."""
+    import dataclasses as _dc
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    on_tpu = devices[0].platform != "cpu"
+    # an fsdp axis >= 2 is what gives the manual path gathers to hide
+    fsdp = max(n_dev // 2, 1)
+    data = n_dev // fsdp
+    if on_tpu:
+        size = dict(d_model=1024, n_layers=8, n_heads=8, n_kv_heads=4,
+                    d_ff=2816, vocab_size=32768)
+        # batch rows must tile data x fsdp = n_dev on pools > 8 chips
+        B, S = max(8, n_dev), 1024
+    else:
+        # d_model pinned at 64 on CPU: XLA:CPU's blocked dot kernels
+        # change fp32 accumulation order above that width, so the
+        # bitwise off/manual equivalence (which the record asserts)
+        # holds exactly on this family — GQA, 4 layers and the 1k
+        # vocab still exercise every reduction class
+        size = dict(d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+                    d_ff=256, vocab_size=1024)
+        B, S = max(8, n_dev), 128
+    cfg = tiny(max_seq_len=S, remat=True, **size)
+    cfg = _dc.replace(cfg, remat_policy=BENCH_REMAT_POLICY)
+    steps = 5
+
+    def run(overlap):
+        plan = ExecutionPlan.from_kwargs(
+            data=data, fsdp=fsdp, per_device_batch=max(B // n_dev, 1),
+            max_seq_len=S, overlap=overlap,
+            donate_state=False, donate_batch=False,
+            compile_cache=False, aot_train_step=False, obs=False,
+            topology=f"{'v5e' if on_tpu else 'cpu'}-{n_dev}")
+        mesh = plan.build_mesh(devices)
+        opt = make_optimizer(3e-4)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
+        batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
+                               plan.batch_shardings(mesh))
+        compiled = step.lower(state, batch).compile()
+        report = step_cost_report(compiled, tokens_per_step=B * S)
+        # warmup (compile + first dispatch), then the timed stream
+        state, m = step(state, batch)
+        jax.block_until_ready(m["loss"])
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(m["loss"])
+        losses = [float(v) for v in jax.device_get(losses)]
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return losses, steps * B * S / dt / max(n_dev, 1), report
+
+    loss_off, tps_off, rep_off = run("off")
+    loss_on, tps_on, rep_on = run("manual")
+    bitwise = loss_off == loss_on
+    if not bitwise:
+        print(f"bench overlap: LOSS STREAMS DIVERGED off={loss_off} "
+              f"manual={loss_on}", file=sys.stderr)
+    _emit(
+        f"overlap off-vs-manual A/B ({cfg.d_model}d/{cfg.n_layers}L "
+        f"seq {S}, data={data} fsdp={fsdp}, "
+        f"{devices[0].device_kind} x{n_dev})",
+        tps_on / max(tps_off, 1e-9), "x",
+        {"tokens_per_sec_per_chip_off": round(tps_off, 1),
+         "tokens_per_sec_per_chip_manual": round(tps_on, 1),
+         "losses_bitwise_equal": bitwise,
+         "loss_stream": loss_on,
+         "overlap_frac_off": rep_off.overlap_frac,
+         "overlap_frac_manual": rep_on.overlap_frac,
+         "exposed_collective_bytes_off": rep_off.exposed_collective_bytes,
+         "exposed_collective_bytes_manual":
+             rep_on.exposed_collective_bytes,
+         "collective_bytes_off": rep_off.collective_bytes,
+         "collective_bytes_manual": rep_on.collective_bytes},
+        compare_baseline=False)
+
+
 def bench_serve():
     """BENCH_MODE=serve: the continuous-batching engine A/B
     (serve/engine.py). One JSON line carries BOTH serving throughputs —
@@ -1265,6 +1367,7 @@ def main():
      "compile": bench_compile,
      "elastic": bench_elastic,
      "decode": bench_decode,
+     "overlap": bench_overlap,
      "serve": bench_serve}[mode]()
 
 

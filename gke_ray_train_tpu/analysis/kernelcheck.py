@@ -90,7 +90,8 @@ LEDGER_FLOOR = 1e-9
 # unverifying the kernel (KER006)
 REQUIRED_KERNELS = frozenset({
     "flash_attention", "ring_attention", "a2a_attention",
-    "quant_matmul", "moe_dispatch", "rope", "kvcache_insert"})
+    "quant_matmul", "moe_dispatch", "rope", "kvcache_insert",
+    "fused_norm_rope", "fused_cross_entropy"})
 
 # TPU tiling: lane is always 128; sublane depends on dtype
 SUBLANE = {"float32": 8, "bfloat16": 16, "float16": 16,
@@ -154,14 +155,21 @@ def kernel_constraint_findings(plan, model_cfg, label: str = "",
             "dispatcher refuses it at runtime; declare attn_impl='ring' "
             "(or 'a2a') for context parallelism", label))
 
-    if impl not in ("flash", "ring", "a2a"):
-        return out          # the XLA oracle has no grid to tile
-
     seq = plan.max_seq_len
     s_local = seq // ctx if ctx > 1 and seq % ctx == 0 else seq
     dtype = str(model_cfg.dtype)
     dbytes = 2 if dtype in ("bfloat16", "float16") else 4
     head_dim = model_cfg.resolved_head_dim
+
+    if impl not in ("flash", "ring", "a2a"):
+        # the XLA attention oracle has no grid to tile — but the
+        # FUSED_OPS epilogue kernels (norm/rope, cross-entropy) run
+        # regardless of the attention impl
+        family = plan.topology.split("-", 1)[0]
+        out.extend(_fused_kernel_findings(
+            plan, model_cfg, CHIP_SPECS.get(family, CHIP_SPECS["cpu"]),
+            s_local, dbytes, label))
+        return out
 
     # KER001a: block divisibility against the post-context-sharding
     # sequence — the Pallas grid covers s_local // block blocks, and a
@@ -189,9 +197,9 @@ def kernel_constraint_findings(plan, model_cfg, label: str = "",
             "kernel's [block, head_dim] VMEM blocks", label))
 
     # KER002: VMEM footprint of one grid step vs the chip budget
+    family = plan.topology.split("-", 1)[0]
+    chip = CHIP_SPECS.get(family, CHIP_SPECS["cpu"])
     if len(blocks) == 2:
-        family = plan.topology.split("-", 1)[0]
-        chip = CHIP_SPECS.get(family, CHIP_SPECS["cpu"])
         est = estimate_vmem_bytes(blocks["block_q"], blocks["block_kv"],
                                   head_dim, dbytes)
         if est > chip.vmem_bytes:
@@ -203,6 +211,81 @@ def kernel_constraint_findings(plan, model_cfg, label: str = "",
                 f"{dtype}) vs the {chip.name} per-core budget "
                 f"{chip.vmem_bytes / 2**20:.0f} MiB — shrink "
                 "FLASH_BLOCK_Q/FLASH_BLOCK_KV", label))
+    out.extend(_fused_kernel_findings(plan, model_cfg, chip, s_local,
+                                      dbytes, label))
+    return out
+
+
+def _fused_kernel_findings(plan, model_cfg, chip, s_local: int,
+                           dbytes: int, label: str
+                           ) -> List[KernelFinding]:
+    """KER001/KER002 for the FUSED_OPS kernels — their tiling routes
+    through the SAME pick_block/estimate helpers flash uses
+    (ops/fused_norm_rope.py, ops/fused_ce.py), so lint sees the same
+    numbers the kernels will actually pick; a plan with fused_ops off
+    has no fused grid to lint."""
+    if not getattr(plan, "fused_ops", False):
+        return []
+    from gke_ray_train_tpu.ops import fused_ce, fused_norm_rope
+    from gke_ray_train_tpu.ops.flash_attention import pick_block
+
+    out: List[KernelFinding] = []
+    d_model = model_cfg.d_model
+    vocab = model_cfg.vocab_size
+    sizes = plan.resolved_sizes()
+    v_local = vocab // sizes["model"] if vocab % sizes["model"] == 0 \
+        else vocab
+    rows = plan.per_device_batch * s_local
+
+    # fused_norm_rope: rows blocked over the per-shard sequence
+    try:
+        bs = pick_block(fused_norm_rope.DEFAULT_BLOCK_S, s_local)
+    except ValueError as e:
+        out.append(KernelFinding(
+            "KER001", "FUSED_BLOCK_S",
+            f"fused_norm_rope block_s="
+            f"{fused_norm_rope.DEFAULT_BLOCK_S} cannot tile the "
+            f"per-shard sequence {s_local}: {e}", label))
+        bs = None
+    if bs is not None:
+        est = fused_norm_rope.estimate_vmem_bytes(bs, d_model, dbytes)
+        if est > chip.vmem_bytes:
+            out.append(KernelFinding(
+                "KER002", "FUSED_BLOCK_S",
+                f"estimated VMEM for one fused_norm_rope grid step is "
+                f"{est / 2**20:.1f} MiB (block_s={bs}, "
+                f"d_model={d_model}) vs the {chip.name} per-core "
+                f"budget {chip.vmem_bytes / 2**20:.0f} MiB — shrink "
+                "FUSED_BLOCK_S", label))
+
+    # fused_cross_entropy: rows = local batch x seq, vocab tiled
+    br = bv = None
+    try:
+        br = pick_block(fused_ce.DEFAULT_BLOCK_R, rows)
+    except ValueError as e:
+        out.append(KernelFinding(
+            "KER001", "FUSED_CE_BLOCK_R",
+            f"fused_cross_entropy block_r={fused_ce.DEFAULT_BLOCK_R} "
+            f"cannot tile the local row count {rows} "
+            f"(= per_device_batch {plan.per_device_batch} x per-shard "
+            f"seq {s_local}): {e}", label))
+    try:
+        bv = pick_block(fused_ce.DEFAULT_BLOCK_V, v_local)
+    except ValueError as e:
+        out.append(KernelFinding(
+            "KER001", "FUSED_CE_BLOCK_V",
+            f"fused_cross_entropy block_v={fused_ce.DEFAULT_BLOCK_V} "
+            f"cannot tile the per-shard vocab {v_local}: {e}", label))
+    if br is not None and bv is not None:
+        est = fused_ce.estimate_vmem_bytes(br, bv, d_model, dbytes)
+        if est > chip.vmem_bytes:
+            out.append(KernelFinding(
+                "KER002", "FUSED_CE_BLOCK_*",
+                f"estimated VMEM for one fused_cross_entropy grid step "
+                f"is {est / 2**20:.1f} MiB (block_r={br}, block_v={bv}, "
+                f"d_model={d_model}) vs the {chip.name} per-core "
+                f"budget {chip.vmem_bytes / 2**20:.0f} MiB — shrink "
+                "FUSED_CE_BLOCK_R/FUSED_CE_BLOCK_V", label))
     return out
 
 

@@ -53,6 +53,12 @@ PLAN_SCOPED_KEYS = frozenset({
     # never compile-relevant (toggling telemetry must not stale a
     # sidecar; plan.COMPILE_SURFACES excludes them).
     "OBS", "OBS_DIR", "OBS_CAPTURE", "OBS_CAPTURE_BUDGET",
+    # kernel & overlap execution path (ROADMAP #3): OVERLAP picks the
+    # collective-hiding mode (off | xla | manual), FUSED_OPS routes the
+    # memory-bound epilogues through the fused Pallas kernels. Both are
+    # compile-relevant (plan.COMPILE_SURFACES includes them on the
+    # train surface, so AOT sidecars stale on a retune).
+    "OVERLAP", "FUSED_OPS",
     # identity: declared chip topology + pinned cost budget
     "TOPOLOGY", "BUDGET_PRESET",
 })

@@ -215,6 +215,31 @@ def _drop_key(rng, tag: int):
     return None if rng is None else jax.random.fold_in(rng, tag)
 
 
+def _rms_norm(x, scale, *, eps, scale_plus_one, fused_ops=False,
+              mesh=None):
+    """rms_norm, optionally through the fused Pallas kernel (plan knob
+    ``FUSED_OPS``). The fused path is oracle-pinned in the kernelcheck
+    tolerance ledger, not bitwise vs the XLA chain. ``mesh`` must ride
+    along on GSPMD call sites: a pallas_call has no SPMD partitioning
+    rule, so under a mesh the kernel is shard_map-wrapped (the flash
+    dispatch discipline)."""
+    if fused_ops:
+        from gke_ray_train_tpu.ops.fused_norm_rope import fused_rmsnorm
+        return fused_rmsnorm(x, scale, eps=eps,
+                             scale_plus_one=scale_plus_one, mesh=mesh)
+    return rms_norm(x, scale, eps=eps, scale_plus_one=scale_plus_one)
+
+
+def _apply_rope_qk(q, k, positions, rope, fused_ops=False, mesh=None):
+    """RoPE on the projected q AND k — one fused Pallas launch when the
+    plan asks for it (shard_map-wrapped under a mesh), else the two
+    separate ops/rope.py dispatches."""
+    if fused_ops:
+        from gke_ray_train_tpu.ops.fused_norm_rope import fused_rope_qk
+        return fused_rope_qk(q, k, positions, rope, mesh=mesh)
+    return apply_rope(q, positions, rope), apply_rope(k, positions, rope)
+
+
 def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0,
          drop_rng=None, drop_rate=0.0):
     def lr(name):
@@ -235,7 +260,7 @@ def _mlp(x, lp, cfg: ModelConfig, dtype, lora_p=None, lora_scale=1.0,
 
 def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
           window, segment_ids, mesh, lora_p=None, lora_scale=1.0,
-          drop_rng=None, drop_rate=0.0):
+          drop_rng=None, drop_rate=0.0, fused_ops=False):
     B, S, D = x.shape
     hd = cfg.resolved_head_dim
     H, K = cfg.n_heads, cfg.n_kv_heads
@@ -254,8 +279,8 @@ def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
     q = _constrain(q, mesh, BATCH_AXES, AXIS_CONTEXT, "model", None)
     k = _constrain(k, mesh, BATCH_AXES, AXIS_CONTEXT, "model", None)
     if rope is not None:
-        q = apply_rope(q, positions, rope)
-        k = apply_rope(k, positions, rope)
+        q, k = _apply_rope_qk(q, k, positions, rope,
+                              fused_ops=fused_ops, mesh=mesh)
     if impl == "xla":
         out = dot_product_attention(
             q, k, v, mask, scale=cfg.attn_scale,
@@ -275,6 +300,79 @@ def _attn(x, lp, cfg: ModelConfig, impl, dtype, rope, positions, mask,
                  _drop_key(drop_rng, 3), drop_rate)
 
 
+def run_block_stack(x, aux, layer_slice, cfg: ModelConfig, impl, dtype,
+                    rope, positions, masks, segment_ids, mesh, *,
+                    lora_slice=None, lora_scale: float = 1.0,
+                    lora_dropout: float = 0.0, rep_rng=None,
+                    token_weights=None, fused_ops: bool = False):
+    """One repeat of the stacked block pattern — the body every layer
+    loop shares. ``forward``'s scan and the manual-overlap pipeline
+    (train/overlap.py) both call exactly this function, so the per-layer
+    math cannot fork between the GSPMD and shard_map paths (the bitwise
+    off/manual equivalence the overlap tests assert rides on that)."""
+    eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
+    moe = cfg.n_experts > 0
+    for p, kind in enumerate(cfg.block_pattern):
+        lp = layer_slice[p]
+        lo = lora_slice[p] if lora_slice is not None else None
+        drng = (jax.random.fold_in(rep_rng, p)
+                if rep_rng is not None else None)
+        h = _rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1,
+                      fused_ops=fused_ops, mesh=mesh)
+        h = _attn(h, lp, cfg, impl, dtype, rope, positions,
+                  masks[kind],
+                  cfg.sliding_window if kind == "sliding" else None,
+                  segment_ids, mesh, lora_p=lo, lora_scale=lora_scale,
+                  drop_rng=_drop_key(drng, 0), drop_rate=lora_dropout,
+                  fused_ops=fused_ops)
+        if cfg.post_block_norm:
+            h = _rms_norm(h, lp["attn_post_norm"], eps=eps,
+                          scale_plus_one=sp1, fused_ops=fused_ops,
+                          mesh=mesh)
+        x = x + h
+        x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+        h = _rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1,
+                      fused_ops=fused_ops, mesh=mesh)
+        if moe:
+            # MoE MLP (ops/moe.py). LoRA adapts attention only on
+            # MoE models — there is no single delta-W an adapter
+            # pair could target across routed experts.
+            from gke_ray_train_tpu.ops.moe import moe_mlp
+            h, a = moe_mlp(h, lp["router"], lp["w_gate"], lp["w_up"],
+                           lp["w_down"], cfg, dtype,
+                           weights=token_weights)
+            aux = aux + a
+        else:
+            h = _mlp(h, lp, cfg, dtype, lora_p=lo,
+                     lora_scale=lora_scale,
+                     drop_rng=_drop_key(drng, 1),
+                     drop_rate=lora_dropout)
+        if cfg.post_block_norm:
+            h = _rms_norm(h, lp["mlp_post_norm"], eps=eps,
+                          scale_plus_one=sp1, fused_ops=fused_ops,
+                          mesh=mesh)
+        x = x + h
+        x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+    return x, aux
+
+
+def resolve_seq_impl(cfg: ModelConfig, mesh, S: int) -> str:
+    """The attention impl a sequence of length S actually runs — the
+    pipe-mesh remap plus the S % 128 dense fallback ``forward`` applies
+    (shared with train/overlap.py so both paths fall back identically)."""
+    pipe_n = 1
+    if mesh is not None and "pipe" in mesh.shape:
+        pipe_n = int(mesh.shape["pipe"])
+    impl = cfg.resolved_attn_impl
+    if pipe_n > 1 and impl in ("ring", "a2a") \
+            and mesh.shape[AXIS_CONTEXT] == 1:
+        impl = "flash"
+    if impl == "flash" and S % 128 != 0:
+        _warn_flash_fallback(S)
+        impl = "xla"
+    return impl
+
+
 def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             positions: Optional[jnp.ndarray] = None,
             segment_ids: Optional[jnp.ndarray] = None,
@@ -285,7 +383,9 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lora_rng: Optional[jax.Array] = None,
             pipe_microbatches: Optional[int] = None,
             with_aux: bool = False,
-            token_weights: Optional[jnp.ndarray] = None):
+            token_weights: Optional[jnp.ndarray] = None,
+            fused_ops: bool = False,
+            return_pre_unembed: bool = False):
     """tokens [B, S] int32 → logits [B, S, vocab] float32.
 
     ``lora``: optional adapter pytree from train/lora.py (same block
@@ -306,10 +406,18 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     ``token_weights`` (optional [B, S]): passed to the MoE router aux so
     load balance is computed over REAL tokens, not padding (the train
     step passes the loss weights; ADVICE r4). Ignored by dense models.
+
+    ``fused_ops``: route the rms_norm / rope epilogues through the
+    fused Pallas kernels (plan knob ``FUSED_OPS``; tolerance-pinned,
+    not bitwise vs the XLA dispatches).
+
+    ``return_pre_unembed``: return the final-normed hidden state
+    [B, S, D] instead of logits — the fused cross-entropy path
+    (ops/fused_ce.py) consumes it so the [B, S, V] logits are never
+    materialized in HBM.
     """
     B, S = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
-    eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one
 
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -339,22 +447,10 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
     if mesh is not None and "pipe" in mesh.shape:
         pipe_n = int(mesh.shape["pipe"])
 
-    impl = cfg.resolved_attn_impl
-    if pipe_n > 1 and impl in ("ring", "a2a") \
-            and mesh.shape[AXIS_CONTEXT] == 1:
-        # on a pipelined mesh with context=1, ring/a2a equal flash — remap
-        # BEFORE the S%128 check below so odd lengths still get the dense
-        # fallback instead of crashing in the kernel (with context>1 the
-        # impl passes through: the CP kernels take the stage-folded batch
-        # spec via dispatch's batch_axes)
-        impl = "flash"
-    if impl == "flash" and S % 128 != 0:
-        # flash needs a 128-multiple sequence to tile; odd eval/infer
-        # lengths fall back to the dense-mask oracle instead of crashing
-        # — loudly, since the O(S²) memory/speed hit is easy to miss
-        # (ADVICE r1: silent fallback)
-        _warn_flash_fallback(S)
-        impl = "xla"
+    # pipe remap (ring/a2a on context=1 pipelined meshes equal flash)
+    # plus the loud S % 128 dense fallback — shared with the manual
+    # overlap path so both fall back identically
+    impl = resolve_seq_impl(cfg, mesh, S)
 
     if pipe_n > 1:
         # pipeline-parallel block stack (models/pipeline.py); falls
@@ -370,10 +466,13 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
             lora_blocks=lora["blocks"] if lora is not None else None,
             lora_scale=lora_scale, n_microbatches=pipe_microbatches,
             token_weights=token_weights)
-        logits = _unembed(x, params, cfg, dtype, mesh)
+        if return_pre_unembed:
+            out = pre_unembed(x, params, cfg, mesh)
+        else:
+            out = _unembed(x, params, cfg, dtype, mesh)
         if with_aux:
-            return logits, {"router_aux": pipe_aux / cfg.n_layers}
-        return logits
+            return out, {"router_aux": pipe_aux / cfg.n_layers}
+        return out
 
     # dense masks are shared by every layer of the same kind — build once.
     # Kernel impls (flash/ring) build masks blockwise in-kernel instead.
@@ -398,42 +497,12 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
         layer_slice = xs_slice[0]
         lora_slice = xs_slice[1] if lora is not None else None
         rep_rng = xs_slice[-1] if drop_keys is not None else None
-        for p, kind in enumerate(cfg.block_pattern):
-            lp = layer_slice[p]
-            lo = lora_slice[p] if lora_slice is not None else None
-            drng = (jax.random.fold_in(rep_rng, p)
-                    if rep_rng is not None else None)
-            h = rms_norm(x, lp["attn_norm"], eps=eps, scale_plus_one=sp1)
-            h = _attn(h, lp, cfg, impl, dtype, rope, positions,
-                      masks[kind],
-                      cfg.sliding_window if kind == "sliding" else None,
-                      segment_ids, mesh, lora_p=lo, lora_scale=lora_scale,
-                      drop_rng=_drop_key(drng, 0), drop_rate=lora_dropout)
-            if cfg.post_block_norm:
-                h = rms_norm(h, lp["attn_post_norm"], eps=eps,
-                             scale_plus_one=sp1)
-            x = x + h
-            x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
-            h = rms_norm(x, lp["mlp_norm"], eps=eps, scale_plus_one=sp1)
-            if moe:
-                # MoE MLP (ops/moe.py). LoRA adapts attention only on
-                # MoE models — there is no single delta-W an adapter
-                # pair could target across routed experts.
-                from gke_ray_train_tpu.ops.moe import moe_mlp
-                h, a = moe_mlp(h, lp["router"], lp["w_gate"], lp["w_up"],
-                               lp["w_down"], cfg, dtype,
-                               weights=token_weights)
-                aux = aux + a
-            else:
-                h = _mlp(h, lp, cfg, dtype, lora_p=lo,
-                         lora_scale=lora_scale,
-                         drop_rng=_drop_key(drng, 1),
-                         drop_rate=lora_dropout)
-            if cfg.post_block_norm:
-                h = rms_norm(h, lp["mlp_post_norm"], eps=eps,
-                             scale_plus_one=sp1)
-            x = x + h
-            x = _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+        x, aux = run_block_stack(
+            x, aux, layer_slice, cfg, impl, dtype, rope, positions,
+            masks, segment_ids, mesh, lora_slice=lora_slice,
+            lora_scale=lora_scale, lora_dropout=lora_dropout,
+            rep_rng=rep_rng, token_weights=token_weights,
+            fused_ops=fused_ops)
         return (x, aux), None
 
     body = repeat_body
@@ -452,19 +521,37 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: ModelConfig, *,
         xs.append(drop_keys)
     (x, aux_sum), _ = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)), tuple(xs))
-    logits = _unembed(x, params, cfg, dtype, mesh)
+    if return_pre_unembed:
+        out = pre_unembed(x, params, cfg, mesh)
+    else:
+        out = _unembed(x, params, cfg, dtype, mesh)
     if with_aux:
-        return logits, {"router_aux": aux_sum / cfg.n_layers if moe
-                        else aux_sum}
-    return logits
+        return out, {"router_aux": aux_sum / cfg.n_layers if moe
+                     else aux_sum}
+    return out
+
+
+def pre_unembed(x, params: Params, cfg: ModelConfig, mesh):
+    """The final-normed hidden state — everything of ``_unembed`` up to
+    (but not including) the vocab matmul. The fused cross-entropy path
+    (ops/fused_ce.py) takes it together with :func:`unembed_head` so
+    the [B, S, vocab] logits never materialize in HBM."""
+    x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps,
+                 scale_plus_one=cfg.norm_scale_plus_one)
+    return _constrain(x, mesh, BATCH_AXES, AXIS_CONTEXT, None)
+
+
+def unembed_head(params: Params, cfg: ModelConfig):
+    """The [D, vocab] unembedding matrix (tied or dedicated)."""
+    return params["embed"].T if cfg.tie_embeddings else params["lm_head"]
 
 
 def _unembed(x, params: Params, cfg: ModelConfig, dtype, mesh):
     """Shared tail: final norm → (tied) unembedding → logit softcap."""
     x = rms_norm(x, params["final_norm"], eps=cfg.norm_eps,
                  scale_plus_one=cfg.norm_scale_plus_one)
-    head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
-    logits = jnp.einsum("bsd,dv->bsv", x, head.astype(dtype),
+    logits = jnp.einsum("bsd,dv->bsv", x, unembed_head(params, cfg
+                                                       ).astype(dtype),
                         preferred_element_type=jnp.float32)
     if cfg.logit_softcap is not None:
         logits = jnp.tanh(logits / cfg.logit_softcap) * cfg.logit_softcap

@@ -50,6 +50,8 @@ import os as _os
 
 
 def _block_env(name: str, default: int) -> int:
+    """Env-overridable block size (shared with the fused epilogue
+    kernels — ops/fused_norm_rope.py / ops/fused_ce.py import it)."""
     raw = _os.environ.get(name, "").strip()
     if not raw:
         return default
@@ -57,6 +59,15 @@ def _block_env(name: str, default: int) -> int:
         return int(raw)
     except ValueError:
         raise ValueError(f"{name}={raw!r} is not an integer") from None
+
+
+def interpret_default(interpret: "Optional[bool]") -> bool:
+    """Resolve the Pallas interpret default: off-TPU (CPU smoke/tests)
+    the Mosaic kernels can't compile, so the same kernel runs under the
+    interpreter. One rule for every kernel module."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return interpret
 
 
 DEFAULT_BLOCK_Q = _block_env("FLASH_BLOCK_Q", 256)
@@ -496,10 +507,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     T = k.shape[1]
     if H % k.shape[2]:
         raise ValueError(f"H={H} not a multiple of KV heads {k.shape[2]}")
-    if interpret is None:
-        # off-TPU (CPU smoke/tests) the Mosaic kernel can't compile —
-        # run the same kernel under the Pallas interpreter
-        interpret = jax.default_backend() != "tpu"
+    interpret = interpret_default(interpret)
     scale = dh ** -0.5 if scale is None else scale
     block_q = pick_block(block_q, S)
     block_kv = pick_block(block_kv, T)

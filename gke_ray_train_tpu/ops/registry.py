@@ -498,6 +498,178 @@ register(KernelSpec(
 ))
 
 
+# -- fused epilogue kernels (plan knob FUSED_OPS) ---------------------------
+
+def _fnr_inputs(case: KernelCase, key: jax.Array, B=2, S=128, H=4, K=2,
+                dh=32, D=64):
+    mode = case.kw().get("mode", "composed")
+    dt = jnp.dtype(case.dtype)
+    ks = jax.random.split(key, 4)
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    if mode == "norm":
+        x = jax.random.normal(ks[0], (B, S, D), jnp.float32).astype(dt)
+        scale = jax.random.normal(ks[1], (D,), jnp.float32) * 0.1 + 1.0
+        return (x, scale), (0, 1)
+    if mode == "rope_qk":
+        q = jax.random.normal(ks[0], (B, S, H, dh), jnp.float32).astype(dt)
+        k = jax.random.normal(ks[1], (B, S, K, dh), jnp.float32).astype(dt)
+        return (q, k, positions), (0, 1)
+    x = jax.random.normal(ks[0], (B, S, H, dh), jnp.float32).astype(dt)
+    scale = jax.random.normal(ks[1], (dh,), jnp.float32) * 0.1 + 1.0
+    return (x, scale, positions), (0, 1)
+
+
+def _fnr_freqs(dh: int):
+    from gke_ray_train_tpu.ops.rope import rope_frequencies
+    return jnp.asarray(rope_frequencies(dh))
+
+
+def _fnr_kernel(case: KernelCase, mesh, *args):
+    from gke_ray_train_tpu.ops.fused_norm_rope import (
+        fused_rmsnorm, fused_rmsnorm_rope, fused_rope_qk)
+    mode = case.kw().get("mode", "composed")
+    if mode == "norm":
+        x, scale = args
+        return fused_rmsnorm(x, scale, interpret=True, mesh=mesh)
+    if mode == "rope_qk":
+        q, k, positions = args
+        qr, kr = fused_rope_qk(q, k, positions, _fnr_freqs(q.shape[-1]),
+                               interpret=True, mesh=mesh)
+        return {"q": qr, "k": kr}
+    x, scale, positions = args
+    return fused_rmsnorm_rope(x, scale, positions,
+                              _fnr_freqs(x.shape[-1]), interpret=True)
+
+
+def _fnr_oracle(case: KernelCase, mesh, *args):
+    """The separate-dispatch references the kernel fuses: ops/norms.py
+    + ops/rope.py, composed the same way."""
+    from gke_ray_train_tpu.ops.norms import rms_norm
+    from gke_ray_train_tpu.ops.rope import apply_rope
+    mode = case.kw().get("mode", "composed")
+    if mode == "norm":
+        x, scale = args
+        return rms_norm(x, scale)
+    if mode == "rope_qk":
+        q, k, positions = args
+        freqs = _fnr_freqs(q.shape[-1])
+        return {"q": apply_rope(q, positions, freqs),
+                "k": apply_rope(k, positions, freqs)}
+    x, scale, positions = args
+    return apply_rope(rms_norm(x, scale), positions,
+                      _fnr_freqs(x.shape[-1]))
+
+
+def _fnr_numerics_targets() -> List[tuple]:
+    """bf16 traced bodies for the KER004/KER005 jaxpr lint (the stress
+    dtype — see the flash targets)."""
+    from gke_ray_train_tpu.ops.fused_norm_rope import (
+        fused_rmsnorm, fused_rmsnorm_rope)
+    bf = jnp.bfloat16
+    return [
+        ("fused_rmsnorm/bfloat16",
+         lambda x, s: fused_rmsnorm(x, s, interpret=True),
+         (jax.ShapeDtypeStruct((2, 128, 32), bf),
+          jax.ShapeDtypeStruct((32,), jnp.float32))),
+        ("fused_rmsnorm_rope/bfloat16",
+         lambda x, s, p: fused_rmsnorm_rope(
+             x, s, p, _fnr_freqs(32), interpret=True),
+         (jax.ShapeDtypeStruct((2, 128, 2, 32), bf),
+          jax.ShapeDtypeStruct((32,), jnp.float32),
+          jax.ShapeDtypeStruct((2, 128), jnp.int32))),
+    ]
+
+
+register(KernelSpec(
+    name="fused_norm_rope",
+    build=_fnr_inputs,
+    kernel=_fnr_kernel,
+    oracle=_fnr_oracle,
+    numerics_targets=_fnr_numerics_targets,
+    cases=(
+        KernelCase("norm_f32", kwargs=(("mode", "norm"),)),
+        KernelCase("norm_bf16", dtype="bfloat16",
+                   kwargs=(("mode", "norm"),)),
+        KernelCase("rope_qk_f32", kwargs=(("mode", "rope_qk"),)),
+        KernelCase("composed_f32"),
+        KernelCase("composed_bf16", dtype="bfloat16"),
+    ),
+))
+
+
+def _fce_inputs(case: KernelCase, key: jax.Array, B=2, S=128, D=64,
+                V=256):
+    B = case.kw().get("B", B)   # sharded cases size B to the batch axes
+    dt = jnp.dtype(case.dtype)
+    ks = jax.random.split(key, 4)
+    x = (jax.random.normal(ks[0], (B, S, D), jnp.float32) * 0.5).astype(dt)
+    head = (jax.random.normal(ks[1], (D, V), jnp.float32) * 0.05
+            ).astype(dt)
+    targets = jax.random.randint(ks[2], (B, S), 0, V, jnp.int32)
+    # padding rows ride along: weight-0 rows must not move the loss
+    weights = (jax.random.uniform(ks[3], (B, S)) > 0.2
+               ).astype(jnp.float32)
+    return (x, head, targets, weights), (0, 1)
+
+
+def _fce_kernel(case: KernelCase, mesh, x, head, targets, weights):
+    from gke_ray_train_tpu.ops.fused_ce import fused_cross_entropy
+    nll, w = fused_cross_entropy(
+        x, head, targets, weights, interpret=True, mesh=mesh,
+        block_v=case.kw().get("block_v", 2048))
+    return {"nll": nll, "w": w}
+
+
+def _fce_oracle(case: KernelCase, mesh, x, head, targets, weights):
+    """The unfused loss path: materialized logits + token_nll — exactly
+    what the train step computes with FUSED_OPS off."""
+    from gke_ray_train_tpu.train.step import token_nll
+    logits = jnp.einsum("bsd,dv->bsv", x.astype(jnp.dtype(case.dtype)),
+                        head.astype(jnp.dtype(case.dtype)),
+                        preferred_element_type=jnp.float32)
+    nll, w = token_nll(logits, targets, weights)
+    return {"nll": nll, "w": w}
+
+
+def _fce_numerics_targets() -> List[tuple]:
+    """Value AND grad traces: the grad pulls in the dx/dhead backward
+    kernels whose inner jaxprs the lint walks too."""
+    from gke_ray_train_tpu.ops.fused_ce import fused_cross_entropy
+    bf = jnp.bfloat16
+    args = (jax.ShapeDtypeStruct((2, 128, 32), bf),
+            jax.ShapeDtypeStruct((32, 256), bf),
+            jax.ShapeDtypeStruct((2, 128), jnp.int32),
+            jax.ShapeDtypeStruct((2, 128), jnp.float32))
+
+    def body(x, h, t, w):
+        return jax.grad(
+            lambda a, b: fused_cross_entropy(a, b, t, w,
+                                             interpret=True)[0],
+            argnums=(0, 1))(x, h)
+
+    return [("fused_cross_entropy/bfloat16", body, args)]
+
+
+register(KernelSpec(
+    name="fused_cross_entropy",
+    build=_fce_inputs,
+    kernel=_fce_kernel,
+    oracle=_fce_oracle,
+    numerics_targets=_fce_numerics_targets,
+    cases=(
+        KernelCase("f32"),
+        KernelCase("bf16", dtype="bfloat16"),
+        # force the vocab to tile (V=256 / block 128 = 2 tiles): the
+        # online max/logsumexp carry and the cross-tile label gather
+        # are exercised, not just the single-tile degenerate case
+        KernelCase("vocab_tiled_f32", kwargs=(("block_v", 128),)),
+        KernelCase("sharded_f32",
+                   mesh_axes={"data": 2, "fsdp": 2, "model": 2},
+                   kwargs=(("B", 4),)),
+    ),
+))
+
+
 # -- standalone numerics targets (step code that is not a kernel) -----------
 
 def standalone_numerics_targets() -> List[tuple]:

@@ -212,12 +212,19 @@ class Preset:
     batch: int = 8
     seq: int = 64
     remat: bool = True
+    # the overlap mode the budget measures (plan.OVERLAP_MODES): train
+    # presets pin the manual shard_map pipeline — the overlap_frac /
+    # exposed_collective_bytes numbers ROADMAP #3 moves live here
+    overlap: str = "manual"
 
 
 PRESETS = {
-    # fsdp grad path: reduce-scatter/all-gather family under GSPMD
+    # fsdp grad path: the per-layer weight all-gathers are the overlap
+    # target — double-buffered behind compute by the manual pipeline
     "tiny_fsdp8": Preset("tiny_fsdp8", {"data": 2, "fsdp": 4}),
     # pure data-parallel grad path: the classic gradient all-reduce
+    # (no param gathers to hide — the manual path pins the same
+    # program shape so the two presets stay comparable)
     "tiny_dp8": Preset("tiny_dp8", {"data": 8, "fsdp": 1}),
 }
 
@@ -319,6 +326,14 @@ def plan_for_preset(preset: Union[str, "Preset"]):
         grad_accum=1, max_seq_len=p.seq, packing=False,
         donate_state=False, donate_batch=False,
         prefetch=0, compile_cache=False, aot_train_step=False,
+        # the overlap path IS the measured program (ROADMAP #3): the
+        # manual shard_map pipeline's double-buffered fsdp gathers are
+        # what moves overlap_frac/exposed_collective_bytes off the
+        # PR-9 zero baseline — and the budget comparator is what keeps
+        # a de-overlap regression (a gather resharded back next to its
+        # consumer) from landing silently. Losses are bitwise-equal to
+        # overlap="off" by construction (tests/test_overlap.py).
+        overlap=p.overlap,
         topology="cpu-8", budget_preset=p.name)
 
 

@@ -112,14 +112,18 @@ _COMPUTE_RE = re.compile(
     r"^(.*?)\s(" + "|".join(_COMPUTE_KINDS) + r")\(")
 
 
-def _computations(hlo_text: str) -> List[List[Tuple[str, str]]]:
-    """Per-computation [(name, rhs)] op lists, in schedule order (the
-    optimized module prints each computation's ops in the order the
-    scheduler chose). Collectives live in the ENTRY computation AND in
-    loop bodies (a scanned grad-accum step keeps its collectives inside
-    the while body), so exposure is analyzed per computation."""
-    comps: List[List[Tuple[str, str]]] = []
+def _computations(hlo_text: str
+                  ) -> List[Tuple[List[Tuple[str, str]], bool]]:
+    """Per-computation ([(name, rhs)], is_entry) pairs, in schedule
+    order (the optimized module prints each computation's ops in the
+    order the scheduler chose). Collectives live in the ENTRY
+    computation AND in loop bodies (a scanned grad-accum step keeps its
+    collectives inside the while body), so exposure is analyzed per
+    computation — and the carried-to-root classification needs to know
+    which root is a LOOP carry vs the program output."""
+    comps: List[Tuple[List[Tuple[str, str]], bool]] = []
     cur: Optional[List[Tuple[str, str]]] = None
+    is_entry = False
     for line in hlo_text.splitlines():
         stripped = line.strip()
         if cur is None:
@@ -127,41 +131,62 @@ def _computations(hlo_text: str) -> List[List[Tuple[str, str]]]:
             if stripped.endswith("{") and ("->" in stripped
                                            or stripped.startswith("ENTRY")):
                 cur = []
+                is_entry = stripped.startswith("ENTRY")
             continue
         if stripped == "}" or line.startswith("}"):
-            comps.append(cur)
+            comps.append((cur, is_entry))
             cur = None
             continue
         m = _ENTRY_OP_RE.match(line)
         if m:
             cur.append((m.group(1), m.group(2)))
     if cur:
-        comps.append(cur)
+        comps.append((cur, is_entry))
     return comps
 
 
 def overlap_stats(hlo_text: str) -> Tuple[int, float, List[str]]:
     """(exposed_collective_bytes, overlap_frac, attribution lines).
 
-    Walks the scheduled ENTRY computation and classifies every
-    collective as *hidden* (async ``-start``/``-done`` pair with
-    independent compute scheduled inside the window) or *EXPOSED*
-    (synchronous form, or an async pair whose window is empty — the
-    step stalls for the full fabric latency). For each collective the
-    attribution line also reports the independent compute — ops that
-    are neither ancestors nor descendants of the collective — i.e. the
-    work a latency-hiding schedule COULD move into its window. That
-    number is the actionable half: ``exposed > 0`` with independent
-    compute available is exactly the overlap opportunity ROADMAP #3
-    asserts through budgets.
+    Walks the scheduled computations and classifies every collective as
+    *hidden* or *EXPOSED*. Three ways to be hidden, all bytes-weighted
+    (a window must hold at least the collective's own result bytes of
+    independent compute — a 1-op window cannot mask a multi-MB
+    all-gather):
+
+    - an async ``-start``/``-done`` pair with enough independent
+      compute scheduled inside the window;
+    - a synchronous collective *scheduled ahead of its first consumer*
+      with enough independent compute in the gap (the latency-hiding
+      schedule already moved it — dataflow through copies / bitcasts /
+      tuples / opt-barriers is resolved, so a fence does not count as
+      a consumer);
+    - a synchronous collective whose result is consumed only by the
+      NEXT loop iteration (it flows to the while body's root tuple —
+      the double-buffered prefetch shape ``train/overlap.py`` emits:
+      layer *k+1*'s all-gather is issued while layer *k* computes, so
+      the whole body's independent compute is available to hide it.
+      The CPU list scheduler shows no async pair, but the *dataflow*
+      is schedule-independent — an async runtime (TPU DMA engines,
+      XLA's latency-hiding scheduler) overlaps a carried collective by
+      construction, which is what lets CPU-mesh budgets assert the
+      overlap claim while the accelerator backend is dark).
+
+    Everything else is EXPOSED (the step stalls for the full fabric
+    latency); the attribution line reports ``hidden_compute_bytes`` —
+    the independent compute (neither ancestor nor descendant) a
+    latency-hiding schedule COULD move into its window. That number is
+    the actionable half: ``exposed > 0`` with independent compute
+    available is exactly the overlap opportunity ROADMAP #3 asserts
+    through budgets.
 
     ``overlap_frac`` = hidden bytes / total collective bytes (1.0 when
     the program has no collectives — nothing is exposed)."""
     exposed = 0
     total = 0
     lines: List[str] = []
-    for ops in _computations(hlo_text):
-        e, t, ls = _overlap_in_computation(ops)
+    for ops, is_entry in _computations(hlo_text):
+        e, t, ls = _overlap_in_computation(ops, is_entry=is_entry)
         exposed += e
         total += t
         lines.extend(ls)
@@ -169,18 +194,38 @@ def overlap_stats(hlo_text: str) -> Tuple[int, float, List[str]]:
     return exposed, frac, lines
 
 
-def _overlap_in_computation(ops: List[Tuple[str, str]]
+# ops that move/regroup data without computing: dataflow is resolved
+# THROUGH them when finding a collective's real consumers (a copy or a
+# scheduling fence between a prefetched all-gather and the loop root
+# must not read as "consumed immediately")
+_PASSTHROUGH_KINDS = frozenset({
+    "copy", "bitcast", "tuple", "get-tuple-element", "opt-barrier",
+    "optimization-barrier"})
+_ROOT = "#root"   # sentinel consumer: the computation's root tuple
+
+
+def _overlap_in_computation(ops: List[Tuple[str, str]], *,
+                            is_entry: bool = False
                             ) -> Tuple[int, int, List[str]]:
     index = {name: i for i, (name, _) in enumerate(ops)}
     deps: Dict[str, List[str]] = {}
     users: Dict[str, List[str]] = {n: [] for n, _ in ops}
+    kind_of: Dict[str, str] = {}
     for name, rhs in ops:
-        paren = rhs.find("(")
+        # the opcode is the first WHITESPACE-PRECEDED word directly
+        # followed by "(" — result types never contain one, a
+        # tuple-typed result's leading "(f32[...], ...)" holds no such
+        # pair, and TPU tile-layout annotations ("{1,0:T(8,128)}")
+        # prepend ":" not whitespace, so they can't shadow the opcode
+        km = re.search(r"(?<=\s)([\w\-]+)\(", rhs)
+        kind_of[name] = km.group(1) if km else ""
+        paren = rhs.find(" " + kind_of[name] + "(") if km else -1
         body = rhs[paren:] if paren >= 0 else rhs
         deps[name] = [d for d in re.findall(r"%([\w.\-]+)", body)
                       if d in index and d != name]
         for d in deps[name]:
             users[d].append(name)
+    root = ops[-1][0] if ops else None
 
     def reach(name: str, edges: Dict[str, List[str]]) -> set:
         """Transitive closure from ONE op — two walks per collective
@@ -203,6 +248,31 @@ def _overlap_in_computation(ops: List[Tuple[str, str]]
         m = _COMPUTE_RE.match(rhs)
         if m:
             compute[name] = _shape_bytes(m.group(1))
+
+    def real_consumers(name: str) -> set:
+        """Schedule-independent consumers: dataflow resolved through
+        pass-through ops. The computation root maps to the ``_ROOT``
+        sentinel — a result that only reaches the root tuple is
+        *carried* (consumed by the next loop iteration)."""
+        out: set = set()
+        stack = list(users.get(name, ()))
+        seen: set = set()
+        while stack:
+            u = stack.pop()
+            if u in seen:
+                continue
+            seen.add(u)
+            if kind_of.get(u) in _PASSTHROUGH_KINDS:
+                # a pass-through ROOT (the while body's carry tuple)
+                # is the "next iteration" sentinel; mid-graph
+                # pass-throughs are resolved through
+                if u == root:
+                    out.add(_ROOT)
+                else:
+                    stack.extend(users.get(u, ()))
+            else:
+                out.add(u)
+        return out
 
     # collect collectives: sync ops, and start/done pairs (done's first
     # operand chain leads back to the start op)
@@ -234,20 +304,68 @@ def _overlap_in_computation(ops: List[Tuple[str, str]]
                       if w in compute and w not in desc]
             hidden = sum(compute[w] for w in window)
             total += nbytes
-            if hidden > 0:
+            # bytes-weighted: the window must hold at least the
+            # collective's own bytes of independent compute
+            if hidden >= nbytes and hidden > 0:
                 lines.append(
                     f"{kind} {nbytes}B hidden behind {len(window)} "
                     f"compute op(s) (~{hidden}B results) in its "
                     "start/done window")
                 continue
             exposed += nbytes
-            lines.append(f"{kind} {nbytes}B EXPOSED (async pair with an "
-                         "empty window)")
+            if hidden > 0:
+                lines.append(
+                    f"{kind} {nbytes}B EXPOSED (async window holds only "
+                    f"~{hidden}B of independent compute across "
+                    f"{len(window)} op(s) — a thin window cannot hide "
+                    f"{nbytes}B)")
+            else:
+                lines.append(f"{kind} {nbytes}B EXPOSED (async pair "
+                             "with an empty window)")
             continue
         nbytes = _shape_bytes(m.group(1))
         total += nbytes
+        desc = reach(name, users)
+        anc = reach(name, deps)
+        consumers = real_consumers(name)
+        if consumers <= {_ROOT} and not is_entry:
+            # carried: the result flows only to a NON-ENTRY root tuple
+            # (a while-body carry) — the next iteration consumes it, so
+            # every independent op of this body can hide it (the
+            # double-buffered prefetch shape). In ENTRY the root IS the
+            # program output: a collective feeding only it stalls the
+            # step before returning and stays EXPOSED below.
+            indep_bytes = sum(b for c, b in compute.items()
+                              if c != name and c not in desc
+                              and c not in anc)
+            if indep_bytes >= nbytes and indep_bytes > 0:
+                lines.append(
+                    f"{kind} {nbytes}B hidden (double-buffered: result "
+                    "carried to the next loop iteration; "
+                    f"~{indep_bytes}B independent compute in the body "
+                    "hides it)")
+                continue
+        else:
+            non_root = [index[c] for c in consumers if c != _ROOT]
+            # no real consumer at all (ENTRY-carried: the result feeds
+            # only the program output) — nothing downstream ever waits
+            # overlapped on it; the step stalls before returning, so it
+            # falls through to EXPOSED rather than crediting the whole
+            # trailing schedule as a hiding window
+            if non_root:
+                first = min(non_root)
+                window = [w for w, _ in ops[index[name] + 1:first]
+                          if w in compute and w not in desc]
+                gap_bytes = sum(compute[w] for w in window)
+                if gap_bytes >= nbytes and gap_bytes > 0:
+                    lines.append(
+                        f"{kind} {nbytes}B hidden (scheduled "
+                        f"{first - index[name]} op(s) ahead of its "
+                        f"first consumer; ~{gap_bytes}B independent "
+                        "compute in the gap hides it)")
+                    continue
         exposed += nbytes
-        related = reach(name, deps) | reach(name, users)
+        related = anc | desc
         indep = [c for c in compute if c != name and c not in related]
         indep_bytes = sum(compute[c] for c in indep)
         lines.append(

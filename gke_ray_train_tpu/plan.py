@@ -65,6 +65,46 @@ TOPOLOGY_FAMILIES: Tuple[str, ...] = tuple(sorted(
 
 _TRANSFER_GUARD_MODES = (None, "log", "disallow")
 
+# communication/compute overlap modes for the train step (ROADMAP #3)
+OVERLAP_MODES = ("off", "xla", "manual")
+
+# the compiler flags overlap="xla" applies on a TPU compile surface:
+# XLA's latency-hiding scheduler converts the FSDP all-gathers /
+# grad reduces into async start/done pairs and schedules independent
+# compute into their windows — the budget fields overlap_stats pins.
+# TPU-only: other backends reject the flag names outright, so
+# overlap_compiler_options() gates on the attached backend and the
+# compile falls back to plain flags when a backend refuses them.
+# python bools, NOT "true" strings: jaxlib's option parser accepts
+# bool values / "True" but rejects lowercase "true" with
+# INVALID_ARGUMENT at compile time
+XLA_OVERLAP_OPTIONS: Dict[str, bool] = {
+    "xla_tpu_enable_latency_hiding_scheduler": True,
+    "xla_enable_async_all_gather": True,
+    "xla_enable_async_collective_permute": True,
+    "xla_tpu_enable_async_collective_fusion": True,
+    "xla_tpu_enable_async_collective_fusion_fuse_all_gather": True,
+}
+
+
+def overlap_compiler_options(plan: "ExecutionPlan"
+                             ) -> Optional[Dict[str, bool]]:
+    """The compiler-option dict ``overlap="xla"`` adds to the plan's
+    compile surface, or None when the mode is off/manual or the
+    attached backend is not a TPU (the flags are TPU-scheduler knobs;
+    XLA:CPU rejects unknown option names, and the CPU-mesh program is
+    the bitwise baseline either way)."""
+    if plan.overlap != "xla":
+        return None
+    import jax
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 - dead backend: plain compile
+        return None
+    if backend != "tpu":
+        return None
+    return dict(XLA_OVERLAP_OPTIONS)
+
 
 def _serve_quant_kinds() -> Tuple[str, ...]:
     """ops/quant.py owns the serving quantization vocabulary; imported
@@ -159,6 +199,25 @@ class ExecutionPlan:
     obs_capture: bool = True
     obs_capture_budget: int = 4
 
+    # -- overlap / fused-kernel execution path (ROADMAP #3) -------------
+    # communication/compute overlap mode for the train step:
+    #   off    — the plain GSPMD scan (collectives where GSPMD put them)
+    #   xla    — same program, compiled with XLA's latency-hiding
+    #            scheduler + async-collective flags (TPU backends; the
+    #            flags are inert on the CPU mesh, where the program is
+    #            bitwise-identical to "off" by construction)
+    #   manual — the shard_map microbatch pipeline (train/overlap.py):
+    #            layer k+1's FSDP all-gather is double-buffered behind
+    #            layer k's compute; bitwise-identical losses to "off",
+    #            asserted by test + the BENCH_MODE=overlap A/B
+    overlap: str = "off"
+    # route the memory-bound epilogue ops through the fused Pallas
+    # kernels (ops/fused_norm_rope.py, ops/fused_ce.py) instead of the
+    # separate XLA dispatches. Numerics are oracle-pinned in the
+    # kernelcheck tolerance ledger, NOT bitwise vs the unfused path
+    # (blockwise logsumexp accumulates in a different order).
+    fused_ops: bool = False
+
     # -- identity --------------------------------------------------------
     topology: str = "cpu-8"                   # key into CHIP_COUNTS
     budget_preset: Optional[str] = None       # tests/budgets/<name>.json
@@ -191,6 +250,31 @@ class ExecutionPlan:
         if self.serve_quant not in _serve_quant_kinds():
             raise PlanError(f"serve_quant={self.serve_quant!r} not in "
                             f"{_serve_quant_kinds()}")
+        if self.overlap not in OVERLAP_MODES:
+            raise PlanError(f"overlap={self.overlap!r} not in "
+                            f"{OVERLAP_MODES}")
+        if self.overlap == "manual":
+            # the manual pipeline hand-places the fsdp collectives; the
+            # structural axes would need their own manual collectives
+            # (TP all-reduces, ring permutes, stage pipelining) that
+            # the shard_map path does not emit — refuse loudly instead
+            # of silently computing wrong. A -1 fill is resolved
+            # against the declared topology first: model=-1 that fills
+            # to 1 IS a data/fsdp mesh (an unresolvable fill keeps the
+            # raw value and is refused — better loud than wrong).
+            try:
+                sizes = self.resolved_sizes()
+            except (ValueError, IndexError, KeyError):
+                # unresolvable fill or a bogus topology (whose own
+                # validation error follows below)
+                sizes = {a: getattr(self, a) for a in MESH_AXES}
+            for axis in ("model", "context", "pipe"):
+                if sizes[axis] != 1:
+                    raise PlanError(
+                        f"overlap='manual' supports data/fsdp meshes "
+                        f"only; {axis}={sizes[axis]} — use "
+                        "overlap='xla' (latency-hiding scheduler) on "
+                        "structural-axis topologies")
         if self.topology not in CHIP_COUNTS:
             fam, _, count = self.topology.partition("-")
             if fam not in TOPOLOGY_FAMILIES or not count.isdigit() \
@@ -550,6 +634,8 @@ CONFIG_KEYS: Dict[str, str] = {
     "obs_dir": "OBS_DIR",
     "obs_capture": "OBS_CAPTURE",
     "obs_capture_budget": "OBS_CAPTURE_BUDGET",
+    "overlap": "OVERLAP",
+    "fused_ops": "FUSED_OPS",
     "topology": "TOPOLOGY",
     "budget_preset": "BUDGET_PRESET",
 }
@@ -568,7 +654,14 @@ _MESH_COMPILE_FIELDS: Tuple[str, ...] = (
 _TRAIN_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     "pipe_microbatches", "pipe_virtual_stages",
     "per_device_batch", "grad_accum", "max_seq_len", "packing",
-    "donate_state", "donate_batch")
+    "donate_state", "donate_batch",
+    # overlap rewrites the step's collective schedule (manual: a
+    # different program; xla: different compiler flags on the same
+    # program) and fused_ops swaps epilogue dispatches for Pallas
+    # kernels — both change the compiled train executable, so sidecars
+    # recorded under a different setting must stale (the OBS twin of
+    # this pin asserts the opposite: telemetry knobs are EXCLUDED)
+    "overlap", "fused_ops")
 _SERVE_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     "max_batch", "decode_buckets", "serve_quant")
 COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
@@ -685,11 +778,15 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
         "prefetch",
         # obs telemetry knobs ride to the workers the same way (a
         # driver-side `env OBS_DIR=...` must shape every rank's stream)
-        "obs", "obs_dir", "obs_capture", "obs_capture_budget")))
+        "obs", "obs_dir", "obs_capture", "obs_capture_budget",
+        # a driver-side `env OVERLAP=manual` / `FUSED_OPS=1` A/B must
+        # shape the program every worker compiles
+        "overlap", "fused_ops")))
 
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
-                          "divergence_guard", "obs", "obs_capture"})
+                          "divergence_guard", "obs", "obs_capture",
+                          "fused_ops"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",
@@ -730,6 +827,11 @@ def _coerce(field: str, value: Any) -> Any:
         return ",".join(str(v) for v in vals)
     if field == "serve_quant":
         return str(value).strip().lower() or "none"
+    if field == "overlap":
+        # "", "0" and "false" all mean the plain scan — the env dialect
+        # needs a disabling spelling (`env OVERLAP= python ...`)
+        v = str(value).strip().lower()
+        return "off" if v in ("", "0", "false", "no") else v
     return value
 
 
@@ -791,6 +893,15 @@ def compile_step_with_plan(plan: ExecutionPlan, mesh, fn: Callable,
                       out_shardings=out_shardings)
         argnums = (plan.donate_argnums() if donate_argnums is None
                    else tuple(donate_argnums))
+        opts = overlap_compiler_options(plan)
+        if opts is not None:
+            # overlap="xla" on a TPU backend: the latency-hiding
+            # scheduler flags ride the jit params into every
+            # lower().compile() of this step. A backend that refuses a
+            # flag fails at compile time — fall back to plain flags
+            # there rather than here (the refusal message names the
+            # flag; swallowing it pre-compile would hide WHICH one).
+            kw["compiler_options"] = opts
         fn = jax.jit(fn, donate_argnums=argnums, **kw)
         try:
             fn.donate_argnums = argnums

@@ -229,30 +229,59 @@ def make_train_step(cfg: ModelConfig,
     lora_mode = lora_cfg is not None
     lora_dropout = lora_cfg.dropout if lora_mode else 0.0
     moe = cfg.n_experts > 0
+    overlap = plan.overlap if plan is not None else "off"
+    fused_ops = plan.fused_ops if plan is not None else False
+    # fused cross-entropy (ops/fused_ce.py) replaces materialized
+    # logits + token_nll where its contract holds: no logit softcap
+    # (the cap is applied to logits the kernel never forms) and no
+    # pipeline mesh (the stage-folded batch spec is not the kernel
+    # wrapper's row layout)
+    fused_ce = (fused_ops and cfg.logit_softcap is None
+                and (mesh is None or int(mesh.shape.get("pipe", 1)) == 1))
+
+    manual_grad = None
+    if overlap == "manual":
+        from gke_ray_train_tpu.train.overlap import (
+            check_manual_support, make_manual_grad_fn)
+        check_manual_support(cfg, mesh, lora=lora_mode)
+        manual_grad = make_manual_grad_fn(
+            cfg, mesh,
+            batch_keys=(plan.batch_keys() if plan is not None
+                        else ("inputs", "targets", "weights")),
+            fused_ops=fused_ops, use_fused_ce=fused_ce)
 
     def micro_loss(trainable: Params, frozen: Params, micro: Batch,
                    drop_rng=None):
+        fkw = dict(positions=micro.get("positions"),
+                   segment_ids=micro.get("segment_ids"),
+                   mesh=mesh, pipe_microbatches=pipe_microbatches,
+                   with_aux=moe,
+                   token_weights=micro["weights"] if moe else None,
+                   fused_ops=fused_ops,
+                   return_pre_unembed=fused_ce)
         if lora_mode:
-            out = forward(frozen, micro["inputs"], cfg,
-                          positions=micro.get("positions"),
-                          segment_ids=micro.get("segment_ids"),
-                          mesh=mesh, lora=trainable,
+            out = forward(frozen, micro["inputs"], cfg, lora=trainable,
                           lora_scale=lora_cfg.scale,
                           lora_dropout=lora_dropout,
-                          lora_rng=drop_rng,
-                          pipe_microbatches=pipe_microbatches,
-                          with_aux=moe,
-                          token_weights=micro["weights"] if moe else None)
+                          lora_rng=drop_rng, **fkw)
         else:
-            out = forward(trainable, micro["inputs"], cfg,
-                          positions=micro.get("positions"),
-                          segment_ids=micro.get("segment_ids"),
-                          mesh=mesh,
-                          pipe_microbatches=pipe_microbatches,
-                          with_aux=moe,
-                          token_weights=micro["weights"] if moe else None)
-        logits, aux = out if moe else (out, None)
-        nll, w = token_nll(logits, micro["targets"], micro["weights"])
+            out = forward(trainable, micro["inputs"], cfg, **fkw)
+        hidden, aux = out if moe else (out, None)
+        if fused_ce:
+            from gke_ray_train_tpu.models.transformer import unembed_head
+            from gke_ray_train_tpu.ops.fused_ce import fused_cross_entropy
+            dtype = jnp.dtype(cfg.dtype)
+            # the head must come from the DIFFERENTIATED arg in full
+            # fine-tuning (trainable == params is argnum 0 of grad_fn;
+            # taking it from `frozen` would silently zero the lm_head /
+            # tied-embed gradient). LoRA keeps the frozen base head —
+            # adapters never train the unembedding.
+            head_params = frozen if lora_mode else trainable
+            nll, w = fused_cross_entropy(
+                hidden, unembed_head(head_params, cfg).astype(dtype),
+                micro["targets"], micro["weights"], mesh=mesh)
+        else:
+            nll, w = token_nll(hidden, micro["targets"], micro["weights"])
         if moe:
             # Switch load-balance term, billed per token so the final
             # divide-by-total-weight recovers ce_mean + coef * aux_mean
@@ -283,7 +312,15 @@ def make_train_step(cfg: ModelConfig,
             micro = xs[0]
             drop_rng = xs[1] if drop_rngs is not None else None
             g_acc, nll_acc, w_acc = carry
-            (nll, w), g = grad_fn(trainable, frozen, micro, drop_rng)
+            if manual_grad is not None:
+                # the shard_map microbatch pipeline (train/overlap.py):
+                # per-layer fsdp all-gathers double-buffered behind
+                # compute, grads reduced with GSPMD's exact
+                # accumulation structure — bitwise-identical to the
+                # grad_fn branch, asserted by tests/test_overlap.py
+                (nll, w), g = manual_grad(trainable, micro)
+            else:
+                (nll, w), g = grad_fn(trainable, frozen, micro, drop_rng)
             return (jax.tree.map(jnp.add, g_acc, g),
                     nll_acc + nll, w_acc + w), None
 

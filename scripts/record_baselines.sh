@@ -39,6 +39,13 @@ run decode         env BENCH_MODE=decode python bench.py
 # + p50/p99 per-token latency, batch occupancy, decode StepCostReport
 run serve          env BENCH_MODE=serve python bench.py
 
+# overlap execution path A/B (train/overlap.py, plan knob OVERLAP):
+# OVERLAP=off vs =manual through the real make_train_step — the record
+# asserts bitwise-identical loss streams and carries each arm's
+# scheduled-HLO overlap evidence (overlap_frac / exposed collective
+# bytes), the half of the claim that survives a dead backend
+run overlap        env BENCH_MODE=overlap python bench.py
+
 # fault-tolerance drill: time-to-recover (injected kill -> first
 # post-resume step) + checkpoint-save latency under SIGTERM (must fit
 # the preemption grace window); the record splits recompile time from

@@ -234,7 +234,10 @@ def test_plan_pinning_preset_with_fill_axis_is_clean():
     plan = ExecutionPlan.from_config({
         "MESH_DATA": 2, "MESH_FSDP": -1, "TOPOLOGY": "cpu-8",
         "BUDGET_PRESET": "tiny_fsdp8", "PER_DEVICE_TRAIN_BATCH_SIZE": 1,
-        "MAX_SEQ_LENGTH": 64, "DONATE_STATE": 0, "DONATE_BATCH": 0})
+        "MAX_SEQ_LENGTH": 64, "DONATE_STATE": 0, "DONATE_BATCH": 0,
+        # the preset measures the manual overlap path (ISSUE 12) — a
+        # config pinning its budget must compile the same program
+        "OVERLAP": "manual"})
     assert budget_findings(plan, label="seed") == []
 
 
