@@ -1140,6 +1140,110 @@ def bench_overlap():
         compare_baseline=False)
 
 
+def bench_dcn():
+    """BENCH_MODE=dcn: flat-vs-hier A/B of the cross-slice gradient
+    sync (plan knobs ``DCN_SYNC``/``DCN_COMPRESS``,
+    parallel/hierarchical.py) on the emulated 2-slice hybrid mesh —
+    the canonical 8-fake-device CPU mesh split 2 x 4 with the data
+    axis spanning the slices (the PR-5 contract test_mesh.py pins).
+    Both arms run the SAME model, init and batch stream through
+    ``make_train_step`` with ``OVERLAP=manual``; the only delta is the
+    cross-slice reduction: flat sends the full gradient payload over
+    DCN, hier the 1/ici_size scattered shard. The record asserts the
+    two loss streams BITWISE-identical (the shared slice-staged
+    accumulation grouping) and carries each arm's compile-level
+    network evidence — ``ici_bytes``/``dcn_bytes``/``overlap_frac``
+    from the scheduled HLO + replica-group parse — the half of the
+    claim that survives the dead accelerator backend. value =
+    dcn_bytes(flat)/dcn_bytes(hier), the DCN traffic shrink factor
+    (~= ici_size; wall-clock is meaningless for a DCN claim on one
+    host)."""
+    import dataclasses as _dc
+
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) != 8:
+        # the emulated 2-slice layout is only meaningful on the
+        # canonical mesh (same policy as bench_elastic): re-exec
+        import subprocess
+
+        from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+        env = cpu_mesh_env(BENCH_MODE="dcn")
+        env.pop("GRAFT_FORCE_PROBE", None)
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.perf.costs import step_cost_report
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    n_dev = len(devices)
+    # d_model pinned at 64 on CPU (the bitwise-verified family, see
+    # bench_overlap); GQA + 4 layers + 1k vocab exercise every
+    # reduction class; grad_accum=2 exercises the accum-scan carry the
+    # compressed arm threads its residual through
+    size = dict(d_model=64, n_layers=4, n_heads=4, n_kv_heads=2,
+                d_ff=256, vocab_size=1024)
+    B, S, accum, steps = 16, 128, 2, 5
+    cfg = tiny(max_seq_len=S, remat=True, **size)
+    cfg = _dc.replace(cfg, remat_policy=BENCH_REMAT_POLICY)
+
+    def run(dcn_sync, dcn_compress="none"):
+        plan = ExecutionPlan.from_kwargs(
+            data=2, fsdp=n_dev // 2, num_slices=2,
+            per_device_batch=B // n_dev // accum, grad_accum=accum,
+            max_seq_len=S, overlap="manual", dcn_sync=dcn_sync,
+            dcn_compress=dcn_compress,
+            donate_state=False, donate_batch=False,
+            compile_cache=False, aot_train_step=False, obs=False,
+            topology=f"cpu-{n_dev}")
+        mesh = plan.build_mesh(devices)
+        opt = make_optimizer(3e-4)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
+        batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
+                               plan.batch_shardings(mesh))
+        compiled = step.lower(state, batch).compile()
+        report = step_cost_report(compiled, tokens_per_step=B * S,
+                                  num_slices=2)
+        losses = []
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(m["loss"])
+        return [float(v) for v in jax.device_get(losses)], report
+
+    loss_flat, rep_flat = run("flat")
+    loss_hier, rep_hier = run("hier")
+    loss_comp, rep_comp = run("hier", "bf16")
+    bitwise = loss_flat == loss_hier
+    if not bitwise:
+        print(f"bench dcn: LOSS STREAMS DIVERGED flat={loss_flat} "
+              f"hier={loss_hier}", file=sys.stderr)
+    comp_close = all(abs(a - b) <= 0.05 * max(abs(b), 1e-9)
+                     for a, b in zip(loss_comp, loss_flat))
+    _emit(
+        f"dcn flat-vs-hier gradient sync A/B ({cfg.d_model}d/"
+        f"{cfg.n_layers}L seq {S}, emulated 2-slice 2x{n_dev // 2} "
+        f"hybrid mesh, grad_accum={accum})",
+        rep_flat.dcn_bytes / max(rep_hier.dcn_bytes, 1), "x",
+        {"losses_bitwise_equal": bitwise,
+         "loss_stream": loss_hier,
+         "compressed_loss_stream": loss_comp,
+         "compressed_within_5pct": comp_close,
+         "dcn_bytes_flat": rep_flat.dcn_bytes,
+         "dcn_bytes_hier": rep_hier.dcn_bytes,
+         "dcn_bytes_compressed": rep_comp.dcn_bytes,
+         "ici_bytes_flat": rep_flat.ici_bytes,
+         "ici_bytes_hier": rep_hier.ici_bytes,
+         "overlap_frac_flat": rep_flat.overlap_frac,
+         "overlap_frac_hier": rep_hier.overlap_frac,
+         "collective_bytes_flat": rep_flat.collective_bytes,
+         "collective_bytes_hier": rep_hier.collective_bytes},
+        compare_baseline=False)
+
+
 def bench_serve():
     """BENCH_MODE=serve: the continuous-batching engine A/B
     (serve/engine.py). One JSON line carries BOTH serving throughputs —
@@ -1368,6 +1472,7 @@ def main():
      "elastic": bench_elastic,
      "decode": bench_decode,
      "overlap": bench_overlap,
+     "dcn": bench_dcn,
      "serve": bench_serve}[mode]()
 
 

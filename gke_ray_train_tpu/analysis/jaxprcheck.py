@@ -222,6 +222,34 @@ def unbudgeted_collectives(report: Any, budget: Dict[str, Any]) -> List[str]:
     return out
 
 
+def unbudgeted_dcn_bytes(report: Any, budget: Dict[str, Any],
+                         *, headroom: float = 0.10) -> List[str]:
+    """Cross-slice (DCN) bytes beyond what the checked-in budget
+    sanctions. One-sided like :func:`unbudgeted_collectives` — EXTRA
+    bytes over the slow inter-slice link are the reshard signal (a
+    PartitionSpec change that re-replicates an operand silently turns
+    an intra-slice gather into a slice-spanning one); *fewer* DCN
+    bytes is the two-sided comparator's business. The finding carries
+    the per-op slice-crossing delta so the fattened hop is named."""
+    from gke_ray_train_tpu.perf.budget import _hlo_delta
+    if hasattr(report, "to_dict"):
+        report = report.to_dict()
+    want = budget.get("dcn_bytes")
+    if want is None:        # pre-DCN budget: nothing to gate against
+        return []
+    have = int(report.get("dcn_bytes", 0))
+    if have <= int(want) * (1.0 + headroom):
+        return []
+    lines = _hlo_delta(report.get("dcn_lines", []),
+                       budget.get("dcn_lines", []))
+    return [
+        f"cross-slice DCN bytes beyond the budgeted set ({have} vs "
+        f"budgeted {want}, headroom {headroom:.0%}) — a reshard is "
+        "fattening the slice-spanning hop (full-payload traffic the "
+        "hierarchical sync exists to avoid crossing DCN)\n"
+        + "\n".join(lines)]
+
+
 def donation_findings(compiled, state: Any, *, min_frac: float = 0.8,
                       label: str = "train_step") -> List[str]:
     """Did the declared donation actually hold? ``memory_analysis``
@@ -317,11 +345,19 @@ def check_preset(name: str, *, budget_dir: Optional[str] = None
     compiled, state, batch, jitted = build_preset_step(name,
                                                        with_jitted=True)
 
-    # 1) collectives vs the checked-in budget
-    report = step_cost_report(compiled)
+    # 1) collectives vs the checked-in budget (the DCN attribution runs
+    #    against the preset's declared slice layout) — plus the
+    #    one-sided cross-slice byte rule: a reshard that fattens the
+    #    DCN hop fails `analysis check` even inside the two-sided
+    #    comparator's tolerance band
+    from gke_ray_train_tpu.perf.budget import PRESETS
+    preset = PRESETS[name]
+    report = step_cost_report(compiled, num_slices=preset.num_slices)
     bpath = budget_path(name, budget_dir)
     if os.path.exists(bpath):
-        findings.extend(unbudgeted_collectives(report, load_budget(bpath)))
+        budget = load_budget(bpath)
+        findings.extend(unbudgeted_collectives(report, budget))
+        findings.extend(unbudgeted_dcn_bytes(report, budget))
     else:
         logger.warning("no budget at %s; collective check skipped "
                        "(run: python -m gke_ray_train_tpu.perf.budget "

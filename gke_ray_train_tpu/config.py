@@ -59,6 +59,11 @@ PLAN_SCOPED_KEYS = frozenset({
     # compile-relevant (plan.COMPILE_SURFACES includes them on the
     # train surface, so AOT sidecars stale on a retune).
     "OVERLAP", "FUSED_OPS",
+    # DCN-aware gradient sync (parallel/hierarchical.py): DCN_SYNC
+    # picks the cross-slice reduction arm (flat | hier) on a
+    # multi-slice hybrid mesh; DCN_COMPRESS=bf16 casts only the hier
+    # DCN hop with error feedback. Train-surface compile-relevant.
+    "DCN_SYNC", "DCN_COMPRESS",
     # identity: declared chip topology + pinned cost budget
     "TOPOLOGY", "BUDGET_PRESET",
 })

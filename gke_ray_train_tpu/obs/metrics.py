@@ -58,6 +58,14 @@ METRIC_NAMES: Dict[str, str] = {
     "goodput_lost_s": "gauge",
     "goodput_wall_s": "gauge",
     "goodput_frac": "gauge",
+    # network traffic of the compiled train step (perf/costs.py
+    # StepCostReport, noted once at AOT build time by perf/cache.py —
+    # no second computation): collective bytes split by the fabric
+    # their replica groups span. grt_dcn_bytes is the cross-slice
+    # number DCN_SYNC=hier shrinks; flat-lined at 0 on single-slice
+    # pools by construction.
+    "ici_bytes": "gauge",
+    "dcn_bytes": "gauge",
     # compile-once health (perf/cache.py jax.monitoring counters)
     "compile_cache_hits": "gauge",
     "compile_cache_misses": "gauge",

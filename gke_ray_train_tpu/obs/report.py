@@ -213,6 +213,17 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         totals["goodput_frac"] = totals.get("step_s", 0.0) / \
             totals["wall_s"]
 
+    # network traffic of the compiled step (grt_ici_bytes /
+    # grt_dcn_bytes, noted at AOT build from the StepCostReport): one
+    # per-run summary — every rank compiles the same SPMD program, so
+    # the max across ranks IS the program's number
+    network = {}
+    for key in ("ici_bytes", "dcn_bytes"):
+        vals = [doc.get(key) for doc in metrics.values()
+                if isinstance(doc.get(key), (int, float))]
+        if vals:
+            network[key] = max(vals)
+
     run_end = next((e for e in reversed(events)
                     if e["kind"] == "run_end"), None)
     report = {
@@ -223,6 +234,7 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         "n_attempts": len(attempts),
         "preemptions": run_end.get("preemptions") if run_end else None,
         "goodput": totals or None,
+        "network": network or None,
         "reconciled": reconciled,
         "anomalies": [{k: a.get(k) for k in
                        ("attempt", "rank", "class", "trigger_step",
@@ -248,6 +260,10 @@ def render_text(report: Dict[str, Any]) -> str:
     if g.get("wall_s"):
         L.append("  goodput: {:.1%} of {:.1f}s wall".format(
             g.get("goodput_frac", 0.0), g["wall_s"]))
+    net = report.get("network") or {}
+    if net:
+        L.append("  network: ici {:,}B dcn {:,}B per step".format(
+            int(net.get("ici_bytes", 0)), int(net.get("dcn_bytes", 0))))
     for a in report["attempts"]:
         head = f"attempt {a['attempt']}: {a['status']}"
         if a.get("event"):

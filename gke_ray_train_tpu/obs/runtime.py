@@ -132,6 +132,14 @@ class ObsRun:
         self.emit("step", step=step, epoch=epoch, **payload)
         self.export()
 
+    def note_cost_report(self, report) -> None:
+        """Network gauges from a StepCostReport something else already
+        computed (the AOT build in perf/cache.py, the serve engine's
+        executable_info) — never a second compile-time analysis. One
+        call per attempt; exported with the next registry flush."""
+        self.registry.set_many({"ici_bytes": getattr(report, "ici_bytes", 0),
+                                "dcn_bytes": getattr(report, "dcn_bytes", 0)})
+
     def note_serve(self, stats: Dict[str, Any],
                    replica: Optional[int] = None) -> None:
         export_serve_stats(self.registry, stats)
@@ -192,6 +200,13 @@ def emit(kind: str, step: Optional[int] = None, **payload: Any) -> None:
 
 def registry() -> Optional[MetricsRegistry]:
     return _active.registry if _active is not None else None
+
+
+def note_cost_report(report) -> None:
+    """Module-level twin of :meth:`ObsRun.note_cost_report` — no-op
+    unconfigured, like :func:`emit`."""
+    if _active is not None:
+        _active.note_cost_report(report)
 
 
 def start_attempt(plan=None, config: Optional[dict] = None, *,

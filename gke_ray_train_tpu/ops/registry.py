@@ -670,6 +670,89 @@ register(KernelSpec(
 ))
 
 
+# -- hierarchical DCN gradient sync (plan knobs DCN_SYNC / DCN_COMPRESS) ----
+
+def _hier_topo(case: KernelCase):
+    from gke_ray_train_tpu.parallel.hierarchical import SliceTopology
+    axes = dict(case.mesh_axes or {})
+    return SliceTopology(num_slices=case.kw().get("num_slices", 2),
+                         data=axes.get("data", 2),
+                         fsdp=axes.get("fsdp", 4))
+
+
+def _hier_inputs(case: KernelCase, key: jax.Array, R=8, K=64):
+    x = jax.random.normal(key, (R, K), jnp.float32) \
+        * jax.random.normal(jax.random.fold_in(key, 1), (R, K),
+                            jnp.float32)
+    return (x,), (0,)
+
+
+def _hier_kernel(case: KernelCase, mesh, x):
+    """The slice-staged reduction under shard_map on the emulated
+    hybrid mesh — mode per case: the flat arm (full DCN payload), the
+    hier arm (1/ici_size over DCN), or the compressed bf16 hop with a
+    zero residual (the first-microbatch shape of the error-feedback
+    chain)."""
+    from jax.sharding import PartitionSpec as P
+
+    from gke_ray_train_tpu.ops.smap import shard_map
+    from gke_ray_train_tpu.parallel.hierarchical import (
+        compressed_cross_psum, hier_psum, intra_reduce_shard)
+    topo = _hier_topo(case)
+    mode = case.kw().get("mode", "hier")
+
+    def local(v):
+        if mode == "compressed":
+            p = intra_reduce_shard(v, topo, 1)
+            s, _ = compressed_cross_psum(p, jnp.zeros_like(p), topo)
+            return jax.lax.all_gather(s, "fsdp", axis=1, tiled=True)
+        return hier_psum(v, topo, mode=mode)
+
+    return shard_map(local, mesh=mesh,
+                     in_specs=P(("data", "fsdp"), None),
+                     out_specs=P(None, None), check_vma=False)(x)
+
+
+def _hier_oracle(case: KernelCase, mesh, x):
+    """Mesh-ignorant global sum over the device rows — deliberately
+    blind to slices, groups and staging; the differential error for
+    the f32 arms is pure reassociation (pinned tiny), for the bf16
+    hop the cast resolution (pinned at bf16 scale)."""
+    return jnp.sum(x.astype(jnp.float32), axis=0, keepdims=True)
+
+
+register(KernelSpec(
+    name="hier_psum",
+    build=_hier_inputs,
+    kernel=_hier_kernel,
+    oracle=_hier_oracle,
+    cases=(
+        # grads=False: the registry probe differentiates THROUGH the
+        # shard_map wrapper, whose replicated-output transpose (under
+        # check_vma=False) splits the cotangent 1/n — not the op's
+        # contract. The VJP identity (cotangent passes through
+        # unchanged) is pinned directly in tests/test_dcn.py.
+        KernelCase("flat_staged_f32", grads=False,
+                   mesh_axes={"data": 2, "fsdp": 4},
+                   kwargs=(("mode", "flat"), ("num_slices", 2))),
+        KernelCase("hier_f32", grads=False,
+                   mesh_axes={"data": 2, "fsdp": 4},
+                   kwargs=(("mode", "hier"), ("num_slices", 2))),
+        # di > 1: the data axis keeps a slice-local part, so the hop
+        # scatters (and re-gathers) over BOTH intra axes
+        KernelCase("hier_d4_f32", grads=False,
+                   mesh_axes={"data": 4, "fsdp": 2},
+                   kwargs=(("mode", "hier"), ("num_slices", 2))),
+        # the DCN_COMPRESS=bf16 arm: tolerance pinned at bf16 cast
+        # scale — a silent fp8-ing (or double cast) of the hop moves
+        # it 4x and trips KER101
+        KernelCase("compressed_bf16_hop", grads=False,
+                   mesh_axes={"data": 2, "fsdp": 4},
+                   kwargs=(("mode", "compressed"), ("num_slices", 2))),
+    ),
+))
+
+
 # -- standalone numerics targets (step code that is not a kernel) -----------
 
 def standalone_numerics_targets() -> List[tuple]:

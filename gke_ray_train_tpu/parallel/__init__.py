@@ -17,3 +17,8 @@ from gke_ray_train_tpu.parallel.placement import (  # noqa: F401
     make_place_batch,
     place_batch,
 )
+from gke_ray_train_tpu.parallel.hierarchical import (  # noqa: F401
+    SliceTopology,
+    hier_psum,
+    slice_topology,
+)

@@ -1,0 +1,321 @@
+"""DCN-aware hierarchical gradient sync (ROADMAP #4's perf half).
+
+On a hybrid multi-slice mesh the *data* axis — and only data, per the
+PR-5 contract — spans slices, so every gradient reduction GSPMD emits
+as one flat all-reduce sends its **full payload across the DCN link**
+between slices. Multi-slice systems (MegaScale-style hierarchical
+collectives; Gemini-style multi-slice training, PAPERS.md) decompose
+that reduction so only ``1/ici_size`` of the bytes ever leave a slice:
+
+    intra-slice reduce-scatter (ICI)
+      → inter-slice all-reduce over the scattered shard (DCN)
+        → intra-slice all-gather (ICI)
+
+This module is that decomposition for the manual overlap pipeline
+(``train/overlap.py``), where the program — not GSPMD — places every
+collective. :class:`SliceTopology` factors the mesh's ``data`` axis
+into its slice-crossing and slice-local parts (slices are the
+outermost, contiguous blocks of the data axis — the hybrid layout
+``parallel/mesh.py`` builds and ``test_mesh.py`` pins), and the
+reduction helpers express both ``DCN_SYNC`` arms:
+
+- ``flat``: the full payload crosses DCN (one cross-slice all-reduce
+  per leaf — GSPMD's traffic shape);
+- ``hier``: the scattered shard crosses (``1/ici_size`` of the bytes).
+
+**The bitwise contract.** Both arms stage the accumulation fold at the
+slice boundary — intra-slice partial sums first, the cross-slice
+combine second. That shared grouping is what makes the flat and hier
+loss streams **bitwise-identical** on the CPU mesh (the PR-11
+discipline: match the accumulation grouping, get the bits), and it is
+robust by construction: a reduce-scatter/all-gather decomposition of a
+staged fold sums exactly the same partials in exactly the same order
+(verified empirically on XLA:CPU; the *joint* single all-reduce is a
+left fold over all ranks, which no pre-reducing decomposition can
+reproduce — so on a multi-slice mesh the manual pipeline's flat arm
+stages its fold, costing one ulp-class regrouping exactly once, at the
+``NUM_SLICES`` 1→2 plan change that recompiles everything anyway).
+
+``DCN_COMPRESS=bf16`` additionally casts only the DCN hop of the
+*hier* arm, with error feedback across the grad-accumulation scan
+(microbatch *k*'s quantization residual is added back into microbatch
+*k+1*'s pre-quantization value; the step-final residual is dropped).
+Not bitwise — registered in ``ops/registry.py`` with a kernelcheck
+tolerance ledger. Only fsdp-sharded leaves compress (they carry ~all
+the bytes); replicated leaves and the loss scalars ride f32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_DATA = "data"
+_FSDP = "fsdp"
+
+
+class HierSyncUnsupported(ValueError):
+    """The mesh/plan combination has no hierarchical sync path."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SliceTopology:
+    """The DCN factorization of a data/fsdp mesh: ``data`` =
+    ``num_slices`` (outermost, DCN) x ``data_intra`` (slice-local,
+    ICI). ``ici_size`` is the intra-slice reduction width — the factor
+    the hier hop divides the DCN payload by."""
+    num_slices: int
+    data: int
+    fsdp: int
+
+    @property
+    def data_intra(self) -> int:
+        return self.data // self.num_slices
+
+    @property
+    def ici_size(self) -> int:
+        return self.data_intra * self.fsdp
+
+    @property
+    def intra_groups(self) -> Tuple[Tuple[int, ...], ...]:
+        """data-axis index groups WITHIN a slice (contiguous blocks —
+        slices are outermost on the data axis)."""
+        di = self.data_intra
+        return tuple(tuple(s * di + j for j in range(di))
+                     for s in range(self.num_slices))
+
+    @property
+    def cross_groups(self) -> Tuple[Tuple[int, ...], ...]:
+        """data-axis index groups ACROSS slices (same intra-slice
+        position in every slice — the DCN hop's peers)."""
+        di = self.data_intra
+        return tuple(tuple(s * di + j for s in range(self.num_slices))
+                     for j in range(di))
+
+
+def slice_topology(mesh, num_slices: int) -> Optional[SliceTopology]:
+    """The mesh's :class:`SliceTopology`, or None when single-slice
+    (the joint flat psum is the right — and bitwise-pinned — path
+    there). Validates the PR-5 hybrid contract: the data axis, and
+    only data, spans slices."""
+    if num_slices <= 1:
+        return None
+    data = int(mesh.shape.get(_DATA, 1))
+    fsdp = int(mesh.shape.get(_FSDP, 1))
+    if data % num_slices:
+        raise HierSyncUnsupported(
+            f"data axis ({data}) must be divisible by num_slices "
+            f"({num_slices}) — the data axis is the only axis that "
+            "spans slices (the PR-5 hybrid-mesh contract)")
+    for axis in ("model", "context", "pipe"):
+        if int(mesh.shape.get(axis, 1)) != 1:
+            raise HierSyncUnsupported(
+                f"hierarchical DCN sync supports data/fsdp meshes only "
+                f"(mesh has {axis}={mesh.shape[axis]}); structural axes "
+                "are never touched")
+    return SliceTopology(num_slices=num_slices, data=data, fsdp=fsdp)
+
+
+# ---------------------------------------------------------------------------
+# staged reductions (run INSIDE shard_map — they speak axis names)
+# ---------------------------------------------------------------------------
+
+def staged_psum(x, topo: SliceTopology):
+    """Full-payload psum with the slice-staged fold: fsdp → data-intra
+    → data-cross. Numerically the association both DCN_SYNC arms share;
+    traffic-wise the FLAT arm — the cross stage carries the full leaf
+    over DCN. Used for the flat arm, the loss scalars, and any leaf
+    that cannot scatter."""
+    if topo.fsdp > 1:
+        x = jax.lax.psum(x, _FSDP)
+    if topo.data_intra > 1:
+        x = jax.lax.psum(x, _DATA,
+                         axis_index_groups=[list(g) for g in
+                                            topo.intra_groups])
+    return jax.lax.psum(x, _DATA,
+                        axis_index_groups=[list(g) for g in
+                                           topo.cross_groups])
+
+
+def _scatter_axes(shape: Tuple[int, ...], topo: SliceTopology,
+                  dim: Optional[int] = None
+                  ) -> Optional[Tuple[int, bool]]:
+    """(dim, also_scatter_intra_data): the dim the hier path scatters
+    along, or None when no dim tiles the fsdp width (the leaf rides
+    the staged full-payload path — replicated scalars/tiny vectors)."""
+    dims = range(len(shape)) if dim is None else (dim,)
+    for d in dims:
+        if topo.fsdp > 1 and shape[d] % topo.fsdp == 0 and shape[d] > 0:
+            per = shape[d] // topo.fsdp
+            return d, (topo.data_intra > 1
+                       and per % topo.data_intra == 0)
+        if topo.fsdp == 1 and topo.data_intra > 1 \
+                and shape[d] % topo.data_intra == 0 and shape[d] > 0:
+            return d, True
+    return None
+
+
+def hier_reduce_full(x, topo: SliceTopology, dim: Optional[int] = None):
+    """The hierarchical psum of a full (replicated-result) leaf:
+    reduce-scatter over the intra-slice axes → cross-slice all-reduce
+    over the scattered shard (DCN pays ``1/ici_size`` of the bytes) →
+    all-gather back. Bitwise-identical to :func:`staged_psum` (same
+    partials, same order — the scatter only changes WHERE each partial
+    lands). Falls back to the staged fold when no dim tiles."""
+    plan = _scatter_axes(x.shape, topo, dim)
+    if plan is None:
+        return staged_psum(x, topo)
+    d, scatter_intra = plan
+    intra = [list(g) for g in topo.intra_groups]
+    cross = [list(g) for g in topo.cross_groups]
+    p = x
+    if topo.fsdp > 1:
+        p = jax.lax.psum_scatter(p, _FSDP, scatter_dimension=d,
+                                 tiled=True)
+    if topo.data_intra > 1:
+        if scatter_intra:
+            p = jax.lax.psum_scatter(p, _DATA, scatter_dimension=d,
+                                     tiled=True,
+                                     axis_index_groups=intra)
+        else:
+            p = jax.lax.psum(p, _DATA, axis_index_groups=intra)
+    p = jax.lax.psum(p, _DATA, axis_index_groups=cross)
+    if topo.data_intra > 1 and scatter_intra:
+        p = jax.lax.all_gather(p, _DATA, axis=d, tiled=True,
+                               axis_index_groups=intra)
+    if topo.fsdp > 1:
+        p = jax.lax.all_gather(p, _FSDP, axis=d, tiled=True)
+    return p
+
+
+def flat_reduce_shard(ct, topo: SliceTopology, dim: int):
+    """FLAT arm, fsdp-sharded leaf: staged full-payload psum of the
+    whole cotangent (the cross stage sends the FULL leaf over DCN —
+    GSPMD's all-reduce-then-slice traffic shape), then the local fsdp
+    shard."""
+    full = staged_psum(ct, topo)
+    shard = ct.shape[dim] // topo.fsdp
+    idx = jax.lax.axis_index(_FSDP) * shard
+    return jax.lax.dynamic_slice_in_dim(full, idx, shard, axis=dim)
+
+
+def hier_reduce_shard(ct, topo: SliceTopology, dim: int):
+    """HIER arm, fsdp-sharded leaf: reduce-scatter over fsdp (and the
+    slice-local part of data when it tiles) → cross-slice all-reduce
+    over the scattered shard — ``1/ici_size`` of the bytes over DCN —
+    → gather back only what the local shard needs. Bitwise-identical
+    to :func:`flat_reduce_shard` (same staged fold)."""
+    intra = [list(g) for g in topo.intra_groups]
+    cross = [list(g) for g in topo.cross_groups]
+    p = jax.lax.psum_scatter(ct, _FSDP, scatter_dimension=dim,
+                             tiled=True)
+    scatter_intra = (topo.data_intra > 1
+                     and p.shape[dim] % topo.data_intra == 0)
+    if topo.data_intra > 1:
+        if scatter_intra:
+            p = jax.lax.psum_scatter(p, _DATA, scatter_dimension=dim,
+                                     tiled=True, axis_index_groups=intra)
+        else:
+            p = jax.lax.psum(p, _DATA, axis_index_groups=intra)
+    p = jax.lax.psum(p, _DATA, axis_index_groups=cross)
+    if scatter_intra:
+        p = jax.lax.all_gather(p, _DATA, axis=dim, tiled=True,
+                               axis_index_groups=intra)
+    return p
+
+
+def intra_reduce_shard(ct, topo: SliceTopology, dim: int):
+    """The intra-slice HALF of the sharded-leaf reduction (compressed
+    arm): reduce-scatter over fsdp + slice-local data psum, STOPPING
+    before the DCN hop — the caller applies
+    :func:`compressed_cross_psum` with its error-feedback residual
+    after ``value_and_grad`` hands the partial back."""
+    p = jax.lax.psum_scatter(ct, _FSDP, scatter_dimension=dim,
+                             tiled=True)
+    if topo.data_intra > 1:
+        p = jax.lax.psum(p, _DATA,
+                         axis_index_groups=[list(g) for g in
+                                            topo.intra_groups])
+    return p
+
+
+def compressed_cross_psum(p, residual, topo: SliceTopology,
+                          compress: str = "bf16"):
+    """The compressed DCN hop with error feedback: the intra-slice
+    partial (plus the previous microbatch's residual) is cast to the
+    compression dtype, summed across slices — HALF the (already
+    1/fsdp-scattered) bytes over DCN for bf16 — and the local
+    quantization error becomes the next microbatch's residual.
+    Replica-consistency: the returned value is a function of the
+    cross-slice psum alone, so every slice applies identical gradient
+    updates; the residual is slice-local by design (classic EF-SGD).
+    Returns ``(reduced, new_residual)``, both f32."""
+    if compress != "bf16":
+        raise HierSyncUnsupported(
+            f"DCN_COMPRESS={compress!r} not supported (only 'bf16')")
+    x = p + residual
+    q = x.astype(jnp.bfloat16)
+    err = x - q.astype(jnp.float32)
+    s = jax.lax.psum(q, _DATA,
+                     axis_index_groups=[list(g) for g in
+                                        topo.cross_groups])
+    return s.astype(jnp.float32), err
+
+
+# ---------------------------------------------------------------------------
+# hier_psum: the public custom-vjp composition (registry + tests)
+# ---------------------------------------------------------------------------
+
+def hier_psum(x, topo: SliceTopology, *, mode: str = "hier",
+              dim: Optional[int] = None):
+    """Slice-staged psum of ``x`` over the {data x fsdp} group, as a
+    custom-vjp op: ``mode="flat"`` sends the full payload over DCN
+    (:func:`staged_psum`), ``mode="hier"`` the scattered shard
+    (:func:`hier_reduce_full`) — bitwise-identical values, ``1/ici``
+    of the DCN bytes. The VJP passes the cotangent through unchanged
+    (each participant's partial contributes linearly to the replicated
+    sum) — pinned so AD can never transpose the scatter/gather chain
+    into a differently-grouped reduction that costs the bits."""
+    if mode not in ("flat", "hier"):
+        raise HierSyncUnsupported(f"mode={mode!r} not in ('flat','hier')")
+
+    @jax.custom_vjp
+    def red(v):
+        if mode == "flat":
+            return staged_psum(v, topo)
+        return hier_reduce_full(v, topo, dim)
+
+    def fwd(v):
+        return red(v), None
+
+    def bwd(_, ct):
+        return (ct,)
+
+    red.defvjp(fwd, bwd)
+    return red(x)
+
+
+def leaf_payload_split(shapes: List[Tuple[int, ...]],
+                       topo: SliceTopology) -> Tuple[int, int]:
+    """(flat_dcn_elems, hier_dcn_elems) a gradient tree of the given
+    leaf shapes sends across DCN per reduction — the static arithmetic
+    behind the ``dcn_bytes(hier) <= (1/ici_size + eps) x
+    dcn_bytes(flat)`` budget pin (tests use it as the oracle)."""
+    flat = 0
+    hier = 0
+    for shape in shapes:
+        n = 1
+        for s in shape:
+            n *= s
+        flat += n
+        plan = _scatter_axes(shape, topo)
+        if plan is None:
+            hier += n
+        else:
+            d, scatter_intra = plan
+            denom = topo.fsdp * (topo.data_intra if scatter_intra else 1)
+            hier += n // max(denom, 1)
+    return flat, hier

@@ -48,6 +48,13 @@ DEFAULT_TOLERANCES = {
     "collective_bytes": 0.25,
     "exposed_collective_bytes": 0.25,
     "overlap_frac": 0.05,
+    # network attribution (perf.costs.collective_axis_stats): dcn_bytes
+    # is deliberately TIGHT — the cross-slice hop is the number the
+    # hierarchical sync exists to shrink, and a reshard that silently
+    # fattens it by 10% is exactly the regression the hybrid budgets
+    # gate (a pinned 0 stays exactly 0 on single-slice presets)
+    "ici_bytes": 0.25,
+    "dcn_bytes": 0.10,
 }
 
 BUDGET_DIR = os.path.join(
@@ -114,6 +121,7 @@ def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
     tol.update(tolerances or {})
     viols: List[str] = []
     overlap_tripped = False
+    dcn_tripped = False
     for field, t in tol.items():
         if field not in budget or field not in report:
             continue
@@ -126,11 +134,18 @@ def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
                 f"{t:.0%})")
             if field in ("exposed_collective_bytes", "overlap_frac"):
                 overlap_tripped = True
+            if field == "dcn_bytes":
+                dcn_tripped = True
     if overlap_tripped:
         # the offending schedule region: which collectives changed
         # exposure state (hidden <-> EXPOSED) or appeared/vanished
         viols.extend(_hlo_delta(report.get("exposure_lines", []),
                                 budget.get("exposure_lines", [])))
+    if dcn_tripped:
+        # which collectives changed their slice-crossing byte load —
+        # the reshard-fattened-the-DCN-hop signal, named per op
+        viols.extend(_hlo_delta(report.get("dcn_lines", []),
+                                budget.get("dcn_lines", [])))
 
     want_counts = budget.get("collective_counts")
     if want_counts is not None:
@@ -216,6 +231,12 @@ class Preset:
     # presets pin the manual shard_map pipeline — the overlap_frac /
     # exposed_collective_bytes numbers ROADMAP #3 moves live here
     overlap: str = "manual"
+    # DCN topology + cross-slice sync arm (parallel/hierarchical.py):
+    # hybrid presets emulate num_slices>1 on the fake-8 mesh and pin
+    # ici_bytes/dcn_bytes per DCN_SYNC arm — the budgeted claim that
+    # hier sends 1/ici_size of flat's bytes over the slow link
+    num_slices: int = 1
+    dcn_sync: str = "flat"
 
 
 PRESETS = {
@@ -226,6 +247,18 @@ PRESETS = {
     # (no param gathers to hide — the manual path pins the same
     # program shape so the two presets stay comparable)
     "tiny_dp8": Preset("tiny_dp8", {"data": 8, "fsdp": 1}),
+    # emulated 2-slice hybrid mesh (2 data x 4 fsdp, data spans the
+    # slices — the PR-5 contract, fake-8 emulation pinned in
+    # test_mesh.py): the flat arm's budget pins the full gradient
+    # payload crossing DCN, the hier arm pins the 1/ici_size scattered
+    # hop — the pair IS the recorded evidence for the DCN_SYNC claim,
+    # and test_dcn.py asserts the ratio between the two JSONs
+    "tiny_hybrid_2x4_flat": Preset(
+        "tiny_hybrid_2x4_flat", {"data": 2, "fsdp": 4},
+        num_slices=2, dcn_sync="flat"),
+    "tiny_hybrid_2x4_hier": Preset(
+        "tiny_hybrid_2x4_hier", {"data": 2, "fsdp": 4},
+        num_slices=2, dcn_sync="hier"),
 }
 
 
@@ -322,6 +355,7 @@ def plan_for_preset(preset: Union[str, "Preset"]):
     dp = mesh["data"] * mesh["fsdp"]
     return ExecutionPlan.from_kwargs(
         **mesh,
+        num_slices=p.num_slices, dcn_sync=p.dcn_sync,
         per_device_batch=max(p.batch // max(dp, 1), 1),
         grad_accum=1, max_seq_len=p.seq, packing=False,
         donate_state=False, donate_batch=False,
@@ -396,7 +430,11 @@ def build_preset_report(preset: Union[str, Preset, ServePreset],
         return step_cost_report(compiled, tokens_per_step=p.max_batch)
     p = PRESETS[preset] if isinstance(preset, str) else preset
     compiled, _, _ = build_preset_step(p, remat=remat)
-    return step_cost_report(compiled, tokens_per_step=p.batch * p.seq)
+    # the DCN byte attribution runs against the preset's DECLARED slice
+    # layout (the fake-8 devices carry no slice_index; num_slices is
+    # what maps replica-group positions onto slices)
+    return step_cost_report(compiled, tokens_per_step=p.batch * p.seq,
+                            num_slices=p.num_slices)
 
 
 def budget_path(name: str, budget_dir: Optional[str] = None) -> str:

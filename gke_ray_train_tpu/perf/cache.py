@@ -402,6 +402,25 @@ class GuardedStep:
         return self._jitted.lower(*args, **kw)
 
 
+def _note_cost_report(compiled, plan) -> None:
+    """Feed the obs network gauges (grt_ici_bytes / grt_dcn_bytes)
+    from the StepCostReport of the executable this build already
+    produced — only when a telemetry session is active (the HLO parse
+    is not free), and never fatally (telemetry must not kill a
+    build)."""
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
+    if obs_runtime.active() is None:
+        return
+    try:
+        from gke_ray_train_tpu.perf.costs import step_cost_report
+        ns = getattr(plan, "num_slices", None) if plan is not None \
+            else None
+        obs_runtime.note_cost_report(
+            step_cost_report(compiled, num_slices=ns))
+    except Exception as e:  # noqa: BLE001 - telemetry is best-effort
+        logger.warning("obs cost-report note skipped: %s", e)
+
+
 def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
                        sidecar: Optional[str] = None,
                        label: str = "train_step",
@@ -431,6 +450,10 @@ def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
                         build_s=time.perf_counter() - t0)
             logger.info("%s: deserialized AOT executable in %.2fs (%s)",
                         label, info["build_s"], sidecar)
+            # a warm-restart attempt must feed the obs network gauges
+            # too — the note guards internally against a deserialized
+            # executable that cannot re-serve its analyses
+            _note_cost_report(loaded, plan)
             return GuardedStep(loaded, jitted_fn, info)
     t0 = time.perf_counter()
     try:
@@ -442,6 +465,7 @@ def build_or_load_step(jitted_fn: Callable, *abstract_args: Any,
         return GuardedStep(None, jitted_fn, info)
     info.update(source="compiled", build_s=time.perf_counter() - t0)
     logger.info("%s: AOT compiled in %.2fs", label, info["build_s"])
+    _note_cost_report(compiled, plan)
     if sidecar:
         is_writer = True
         if _backend_initialized():

@@ -32,16 +32,29 @@ class ChipSpec:
     hbm_bytes_per_s: float   # HBM bandwidth per chip
     hbm_bytes: float         # HBM capacity per chip
     vmem_bytes: float = 16 * 2**20   # on-chip vector memory per core
+    # network: intra-slice ICI vs the inter-slice data-center fabric.
+    # Nominal per-chip figures (order-of-magnitude, like the cpu spec's
+    # flops) — what matters for the roofline is the RATIO: DCN is
+    # ~1.5 orders of magnitude slower than ICI, which is why a flat
+    # all-reduce whose full payload crosses slices dominates step time
+    # on multi-slice pools and why hier_psum sends 1/ici_size of it.
+    ici_bytes_per_s: float = 100e9
+    dcn_bytes_per_s: float = 6.25e9   # ~50 Gbit/s per chip share
 
 
 CHIP_SPECS = {
-    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 * 2**30),
-    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 * 2**30),
-    "v4": ChipSpec("v4", 275e12, 1228e9, 32 * 2**30),
-    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 * 2**30),
+    "v5e": ChipSpec("v5e", 197e12, 819e9, 16 * 2**30,
+                    ici_bytes_per_s=200e9),
+    "v5p": ChipSpec("v5p", 459e12, 2765e9, 95 * 2**30,
+                    ici_bytes_per_s=600e9),
+    "v4": ChipSpec("v4", 275e12, 1228e9, 32 * 2**30,
+                   ici_bytes_per_s=300e9),
+    "v6e": ChipSpec("v6e", 918e12, 1640e9, 32 * 2**30,
+                    ici_bytes_per_s=400e9),
     # nominal CPU spec: keeps ceilings finite for the CI mesh; vmem uses
     # the TPU figure so kernelcheck KER002 verdicts match real chips
-    "cpu": ChipSpec("cpu", 1e12, 50e9, 8 * 2**30),
+    "cpu": ChipSpec("cpu", 1e12, 50e9, 8 * 2**30,
+                    ici_bytes_per_s=10e9, dcn_bytes_per_s=1e9),
 }
 
 # device_kind substring → spec key (same matching discipline as
@@ -85,21 +98,271 @@ def _shape_bytes(type_str: str) -> int:
     return total
 
 
-def collective_stats(hlo_text: str) -> Tuple[Dict[str, int], int, List[str]]:
+# ---------------------------------------------------------------------------
+# while-loop trip counts: a collective inside a scan body appears ONCE
+# in the HLO text but executes once PER TRIP — static byte accounting
+# that ignores the multiplier under-reports a grad-accum or layer-scan
+# program by the scan length (the PR-11 caveat, fixed here)
+# ---------------------------------------------------------------------------
+
+# a while op names its body computation and (XLA's loop analysis
+# willing) its statically-known trip count in backend_config. A while
+# can be the computation ROOT (a step whose entry or outer body
+# returns only the scan carry) — the prefix must not hide it.
+_WHILE_RE = re.compile(r"(?:ROOT\s+)?%[\w.\-]+ = [^\n]*?\bwhile\([^\n]*")
+_WHILE_BODY_RE = re.compile(r"body=%([\w.\-]+)")
+_TRIP_RE = re.compile(r'known_trip_count[^0-9]*(\d+)')
+
+
+def _while_trip_counts(hlo_text: str,
+                       comps: Optional[List[Tuple[str, List[str]]]] = None
+                       ) -> Dict[str, int]:
+    """computation name -> executions per step, for every while BODY
+    whose trip count XLA proved statically (``known_trip_count``; a
+    ``compare(iv, constant), direction=LT`` condition is the fallback).
+    Nested loops compose: a body inside a body multiplies through. An
+    unknown trip count conservatively counts once (the pre-fix
+    behavior), never guesses. ``comps``: a precomputed
+    :func:`_computation_lines` split (``step_cost_report`` parses the
+    — potentially multi-MB — HLO text once and shares it)."""
+    if comps is None:
+        comps = _computation_lines(hlo_text)
+    # (containing computation, body name, trips) per while op
+    whiles: List[Tuple[str, str, Optional[int]]] = []
+    cond_of: Dict[str, str] = {}
+    for comp, comp_lines in comps:
+        for line in comp_lines:
+            s = line.strip()
+            if not _WHILE_RE.match(s):
+                continue
+            bm = _WHILE_BODY_RE.search(s)
+            if bm is None:
+                continue
+            tm = _TRIP_RE.search(s)
+            trips = int(tm.group(1)) if tm else None
+            whiles.append((comp, bm.group(1), trips))
+            cm = re.search(r"condition=%([\w.\-]+)", s)
+            if cm:
+                cond_of[bm.group(1)] = cm.group(1)
+    if not whiles:
+        return {}
+    # fallback trip parse: the condition computation's
+    # `compare(iv, constant(N)), direction=LT` — the lax.scan shape
+    unresolved = [b for _, b, t in whiles if t is None]
+    cond_trips: Dict[str, int] = {}
+    if unresolved:
+        consts: Dict[str, Dict[str, int]] = {}
+        lt: Dict[str, List[str]] = {}
+        for comp, comp_lines in comps:
+            for line in comp_lines:
+                s = line.strip()
+                cm = re.match(r"(?:ROOT\s+)?%([\w.\-]+) = s32\[\] "
+                              r"constant\((\d+)\)", s)
+                if cm:
+                    consts.setdefault(comp, {})[cm.group(1)] = \
+                        int(cm.group(2))
+                if "direction=LT" in s and " compare(" in s:
+                    lt.setdefault(comp, []).extend(
+                        re.findall(r"%([\w.\-]+)", s))
+        for body, cond in cond_of.items():
+            operands = lt.get(cond, ())
+            vals = [consts.get(cond, {}).get(o) for o in operands]
+            vals = [v for v in vals if v is not None]
+            if len(vals) == 1:
+                cond_trips[body] = vals[0]
+    # compose nesting: multiplier(body) = trips x multiplier(container)
+    mult: Dict[str, int] = {}
+    trips_of = {b: (t if t is not None else cond_trips.get(b))
+                for _, b, t in whiles}
+    container = {b: c for c, b, _ in whiles}
+    for body in trips_of:
+        m, seen, b = 1, set(), body
+        while b in trips_of and b not in seen:
+            seen.add(b)
+            t = trips_of[b]
+            if t is None:
+                break
+            m *= t
+            b = container[b]
+        mult[body] = m
+    return {b: m for b, m in mult.items() if m > 1}
+
+
+# ---------------------------------------------------------------------------
+# replica-group parsing: which DEVICES a collective spans — the input
+# to the ICI/DCN byte attribution (a group that crosses a slice
+# boundary pays data-center-network latency, not ICI)
+# ---------------------------------------------------------------------------
+
+_RG_IOTA_RE = re.compile(
+    r"replica_groups=\[([0-9,]+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([0-9,{} ]*)\}")
+
+
+def _replica_groups(line: str) -> Optional[List[List[int]]]:
+    """Parse an HLO collective line's replica groups. Handles the
+    explicit ``{{0,1},{2,3}}`` form, the iota ``[2,4]<=[8]`` (optionally
+    ``T(perm)``-transposed) form, and collective-permute's
+    ``source_target_pairs``. Returns None when the line carries no
+    group syntax at all; ``[[]]`` (one empty group) means "all
+    devices" per HLO semantics."""
+    m = _RG_IOTA_RE.search(line)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        reshape = [int(d) for d in m.group(2).split(",")]
+        total = 1
+        for d in reshape:
+            total *= d
+        ids = list(range(total))
+        if m.group(3):
+            import numpy as np
+            perm = [int(p) for p in m.group(3).split(",")]
+            ids = list(np.arange(total).reshape(reshape)
+                       .transpose(perm).flatten())
+        group_size = 1
+        for d in dims[1:]:
+            group_size *= d
+        return [list(map(int, ids[i:i + group_size]))
+                for i in range(0, total, group_size)]
+    m = re.search(r"replica_groups=\{((?:\{[0-9, ]*\},?)*)\}", line)
+    if m is not None:
+        groups = [[int(x) for x in g.split(",") if x.strip()]
+                  for g in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+        if groups:
+            return groups
+        return [[]]   # replica_groups={} = one group of every device
+    m = _PAIRS_RE.search(line)
+    if m is not None:
+        return [[int(x) for x in g.split(",") if x.strip()]
+                for g in re.findall(r"\{([0-9, ]*)\}", m.group(1))]
+    return None
+
+
+def _crosses_slices(groups: Optional[List[List[int]]],
+                    slice_map: List[int]) -> bool:
+    """Does any replica group span more than one slice? Group ids are
+    positions in the program's device assignment; for the hybrid mesh
+    contract (slices are the OUTERMOST, contiguous blocks of the
+    flattened mesh — both ``create_hybrid_device_mesh`` and the
+    emulated fake-device layout, pinned in test_mesh.py) that position
+    maps to a slice via ``slice_map``."""
+    if not slice_map or len(set(slice_map)) <= 1:
+        return False
+    if not groups:
+        return False
+    for g in groups:
+        members = g if g else range(len(slice_map))
+        seen = {slice_map[i] for i in members if i < len(slice_map)}
+        if len(seen) > 1:
+            return True
+    return False
+
+
+def collective_stats(hlo_text: str, *,
+                     _comps=None, _trips=None
+                     ) -> Tuple[Dict[str, int], int, List[str]]:
     """(count-by-kind, total result bytes, matched HLO lines) for every
     collective in an optimized HLO module. The lines ride along so a
-    budget miss can print the actual offending ops, not just a count."""
+    budget miss can print the actual offending ops, not just a count.
+
+    Bytes are weighted by the statically-known while-loop trip count of
+    the computation the op sits in (a collective in a 2-layer scan body
+    executes twice per step); COUNTS stay static op counts — the
+    exact-count check is about program structure, the byte ledger about
+    runtime traffic. Trip-weighted lines carry an ``// x<N>`` suffix.
+
+    ``_comps``/``_trips``: precomputed computation split / trip map —
+    ``step_cost_report`` parses the HLO text once and shares it with
+    all three analyses (a real-model scheduled dump is multi-MB)."""
     counts = {k: 0 for k in COLLECTIVE_KINDS}
     total_bytes = 0
     lines: List[str] = []
-    for line in hlo_text.splitlines():
-        m = _COLL_RE.search(line)
-        if m is None:
-            continue
-        counts[m.group(2)] += 1
-        total_bytes += _shape_bytes(m.group(1))
-        lines.append(line.strip()[:200])
+    comps = _comps if _comps is not None else _computation_lines(hlo_text)
+    trips = _trips if _trips is not None \
+        else _while_trip_counts(hlo_text, comps)
+    for comp, comp_lines in comps:
+        mult = trips.get(comp, 1)
+        for line in comp_lines:
+            m = _COLL_RE.search(line)
+            if m is None:
+                continue
+            counts[m.group(2)] += 1
+            total_bytes += _shape_bytes(m.group(1)) * mult
+            tag = f" // x{mult} while-trip" if mult > 1 else ""
+            lines.append(line.strip()[:200] + tag)
     return counts, total_bytes, lines
+
+
+def collective_axis_stats(hlo_text: str, slice_map: List[int], *,
+                          _comps=None, _trips=None
+                          ) -> Tuple[int, int, List[str]]:
+    """(ici_bytes, dcn_bytes, dcn attribution lines): every
+    collective's result bytes attributed to the interconnect its
+    replica groups span — intra-slice ICI, or DCN when a group crosses
+    the slice boundary. Trip-weighted like :func:`collective_stats`
+    (and sharing its precomputed-parse convention). With a
+    single-slice (or empty) ``slice_map`` everything is ICI by
+    construction."""
+    ici = 0
+    dcn = 0
+    lines: List[str] = []
+    comps = _comps if _comps is not None else _computation_lines(hlo_text)
+    trips = _trips if _trips is not None \
+        else _while_trip_counts(hlo_text, comps)
+    for comp, comp_lines in comps:
+        mult = trips.get(comp, 1)
+        for line in comp_lines:
+            m = _COLL_RE.search(line)
+            if m is None:
+                continue
+            nbytes = _shape_bytes(m.group(1)) * mult
+            groups = _replica_groups(line)
+            if _crosses_slices(groups, slice_map):
+                dcn += nbytes
+                n_slices = len(set(slice_map))
+                lines.append(
+                    f"{m.group(2)} {nbytes}B crosses the slice boundary "
+                    f"(replica groups span {n_slices} slices"
+                    + (f"; x{mult} while-trip" if mult > 1 else "")
+                    + "): " + line.strip()[:140])
+            else:
+                ici += nbytes
+    return ici, dcn, lines
+
+
+def _computation_lines(hlo_text: str) -> List[Tuple[str, List[str]]]:
+    """(computation name, raw op lines) per computation, in file
+    order — the shared walk collective_stats / collective_axis_stats
+    attribute trip counts through. Lines outside any computation
+    header land in an implicit ``""`` fragment (multiplier 1), so bare
+    HLO snippets — unit-test fixtures — still parse."""
+    out: List[Tuple[str, List[str]]] = []
+    cur: List[str] = []
+    name = ""
+    in_comp = False
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if not in_comp:
+            if s.endswith("{") and ("->" in s or s.startswith("ENTRY")):
+                if cur:
+                    out.append((name, cur))
+                m = re.match(r"(?:ENTRY\s+)?%([\w.\-]+)", s)
+                name = m.group(1) if m else "?"
+                cur = []
+                in_comp = True
+            else:
+                cur.append(line)
+            continue
+        if s == "}" or line.startswith("}"):
+            out.append((name, cur))
+            cur = []
+            name = ""
+            in_comp = False
+            continue
+        cur.append(line)
+    if cur:
+        out.append((name, cur))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -113,16 +376,17 @@ _COMPUTE_RE = re.compile(
 
 
 def _computations(hlo_text: str
-                  ) -> List[Tuple[List[Tuple[str, str]], bool]]:
-    """Per-computation ([(name, rhs)], is_entry) pairs, in schedule
-    order (the optimized module prints each computation's ops in the
-    order the scheduler chose). Collectives live in the ENTRY
+                  ) -> List[Tuple[str, List[Tuple[str, str]], bool]]:
+    """Per-computation (comp_name, [(name, rhs)], is_entry) triples, in
+    schedule order (the optimized module prints each computation's ops
+    in the order the scheduler chose). Collectives live in the ENTRY
     computation AND in loop bodies (a scanned grad-accum step keeps its
     collectives inside the while body), so exposure is analyzed per
     computation — and the carried-to-root classification needs to know
     which root is a LOOP carry vs the program output."""
-    comps: List[Tuple[List[Tuple[str, str]], bool]] = []
+    comps: List[Tuple[str, List[Tuple[str, str]], bool]] = []
     cur: Optional[List[Tuple[str, str]]] = None
+    comp_name = ""
     is_entry = False
     for line in hlo_text.splitlines():
         stripped = line.strip()
@@ -132,20 +396,23 @@ def _computations(hlo_text: str
                                            or stripped.startswith("ENTRY")):
                 cur = []
                 is_entry = stripped.startswith("ENTRY")
+                m = re.match(r"(?:ENTRY\s+)?%([\w.\-]+)", stripped)
+                comp_name = m.group(1) if m else "?"
             continue
         if stripped == "}" or line.startswith("}"):
-            comps.append((cur, is_entry))
+            comps.append((comp_name, cur, is_entry))
             cur = None
             continue
         m = _ENTRY_OP_RE.match(line)
         if m:
             cur.append((m.group(1), m.group(2)))
     if cur:
-        comps.append((cur, is_entry))
+        comps.append((comp_name, cur, is_entry))
     return comps
 
 
-def overlap_stats(hlo_text: str) -> Tuple[int, float, List[str]]:
+def overlap_stats(hlo_text: str, *,
+                  _trips=None) -> Tuple[int, float, List[str]]:
     """(exposed_collective_bytes, overlap_frac, attribution lines).
 
     Walks the scheduled computations and classifies every collective as
@@ -181,15 +448,22 @@ def overlap_stats(hlo_text: str) -> Tuple[int, float, List[str]]:
     through budgets.
 
     ``overlap_frac`` = hidden bytes / total collective bytes (1.0 when
-    the program has no collectives — nothing is exposed)."""
+    the program has no collectives — nothing is exposed). Both sides
+    are weighted by the statically-known while-trip count of the
+    computation (a collective in a 2-trip scan body is executed — and
+    exposed or hidden — twice per step; scaling exposed and total
+    together keeps the frac a per-execution property)."""
     exposed = 0
     total = 0
     lines: List[str] = []
-    for ops, is_entry in _computations(hlo_text):
+    trips = _trips if _trips is not None else _while_trip_counts(hlo_text)
+    for comp_name, ops, is_entry in _computations(hlo_text):
         e, t, ls = _overlap_in_computation(ops, is_entry=is_entry)
-        exposed += e
-        total += t
-        lines.extend(ls)
+        mult = trips.get(comp_name, 1)
+        exposed += e * mult
+        total += t * mult
+        lines.extend(ls if mult == 1
+                     else [f"{ln} // x{mult} while-trip" for ln in ls])
     frac = 1.0 if total == 0 else round(1.0 - exposed / total, 6)
     return exposed, frac, lines
 
@@ -390,6 +664,14 @@ class StepCostReport:
         default_factory=dict)
     collective_bytes: int = 0
     collective_lines: List[str] = dataclasses.field(default_factory=list)
+    # network attribution (collective_axis_stats): every collective's
+    # bytes split by the fabric its replica groups span — intra-slice
+    # ICI vs the inter-slice DCN link. On a single-slice mesh
+    # dcn_bytes == 0 by construction; on a hybrid mesh dcn_bytes is THE
+    # budgeted number DCN_SYNC=hier shrinks by 1/ici_size.
+    ici_bytes: int = 0
+    dcn_bytes: int = 0
+    dcn_lines: List[str] = dataclasses.field(default_factory=list)
     # overlap/exposure ledger (overlap_stats): collective bytes the
     # schedule leaves EXPOSED (no compute hides their latency), the
     # hidden fraction, and the per-collective attribution lines — the
@@ -409,17 +691,31 @@ class StepCostReport:
 
     def ceilings(self, chip: Optional[ChipSpec] = None) -> Dict[str, float]:
         """Roofline at ``chip`` (default: the attached device kind):
-        step-time lower bounds from compute and HBM traffic, and the
-        MFU ceiling their ratio implies. An asserted *analytic* bound —
-        measured MFU can only be below it."""
+        step-time lower bounds from compute, HBM traffic, and the
+        network (EXPOSED collective bytes over the fabric they span —
+        ICI intra-slice, DCN across; hidden bytes overlap compute by
+        definition and never bound the step), and the MFU ceiling the
+        binding term implies. An asserted *analytic* bound — measured
+        MFU can only be below it."""
         chip = chip or chip_spec_for_devices()
         t_compute = self.flops / chip.peak_flops
         t_hbm = self.bytes_accessed / chip.hbm_bytes_per_s
-        bound = max(t_compute, t_hbm, 1e-30)
+        # exposed bytes split by fabric in the same dcn:ici proportion
+        # as the total traffic (the schedule does not tag exposure per
+        # fabric); with no attribution recorded everything rides ICI
+        total_coll = max(self.collective_bytes, 1)
+        exp_dcn = self.exposed_collective_bytes * self.dcn_bytes \
+            / total_coll
+        exp_ici = self.exposed_collective_bytes - exp_dcn
+        t_ici = exp_ici / chip.ici_bytes_per_s
+        t_dcn = exp_dcn / chip.dcn_bytes_per_s
+        bound = max(t_compute, t_hbm, t_ici + t_dcn, 1e-30)
         return {
             "chip": chip.name,
             "compute_bound_step_s": t_compute,
             "hbm_bound_step_s": t_hbm,
+            "ici_bound_step_s": t_ici,
+            "dcn_bound_step_s": t_dcn,
             "mfu_ceiling": t_compute / bound,
         }
 
@@ -428,6 +724,7 @@ class StepCostReport:
         if not include_lines:
             d.pop("collective_lines")
             d.pop("exposure_lines")
+            d.pop("dcn_lines")
         return d
 
     @staticmethod
@@ -446,6 +743,8 @@ class StepCostReport:
             "collectives": {k: v for k, v in self.collective_counts.items()
                             if v},
             "collective_bytes": self.collective_bytes,
+            "ici_bytes": self.ici_bytes,
+            "dcn_bytes": self.dcn_bytes,
             "exposed_collective_bytes": self.exposed_collective_bytes,
             "overlap_frac": self.overlap_frac,
         }
@@ -457,11 +756,16 @@ class StepCostReport:
         return out
 
 
-def step_cost_report(compiled, *, tokens_per_step: Optional[int] = None
-                     ) -> StepCostReport:
+def step_cost_report(compiled, *, tokens_per_step: Optional[int] = None,
+                     num_slices: Optional[int] = None) -> StepCostReport:
     """Build a :class:`StepCostReport` from ``jit(...).lower(...)
     .compile()`` output. Works with no accelerator attached — every
-    number comes from XLA's compile-time analyses."""
+    number comes from XLA's compile-time analyses.
+
+    ``num_slices``: the DCN topology the program's collectives are
+    attributed against (``ici_bytes``/``dcn_bytes``; default: the
+    ``slice_assignments`` contract — real devices' ``.slice_index``,
+    else ``$NUM_SLICES``, else one slice = everything ICI)."""
     report = StepCostReport(n_devices=max(len(jax.devices()), 1),
                             tokens_per_step=tokens_per_step)
     ca = compiled.cost_analysis()
@@ -483,11 +787,24 @@ def step_cost_report(compiled, *, tokens_per_step: Optional[int] = None
         hlo = compiled.as_text()
     except Exception:  # noqa: BLE001 - some backends cannot re-text
         hlo = ""
-    counts, cbytes, lines = collective_stats(hlo)
+    # one parse of the (potentially multi-MB) HLO text, shared by the
+    # three collective analyses
+    comps = _computation_lines(hlo)
+    trips = _while_trip_counts(hlo, comps)
+    counts, cbytes, lines = collective_stats(hlo, _comps=comps,
+                                             _trips=trips)
     report.collective_counts = counts
     report.collective_bytes = cbytes
     report.collective_lines = lines
-    exposed, frac, exp_lines = overlap_stats(hlo)
+    from gke_ray_train_tpu.parallel.mesh import slice_assignments
+    slice_map = slice_assignments(jax.devices(), num_slices)
+    ici, dcn, dcn_lines = collective_axis_stats(hlo, slice_map,
+                                                _comps=comps,
+                                                _trips=trips)
+    report.ici_bytes = ici
+    report.dcn_bytes = dcn
+    report.dcn_lines = dcn_lines
+    exposed, frac, exp_lines = overlap_stats(hlo, _trips=trips)
     report.exposed_collective_bytes = exposed
     report.overlap_frac = frac
     report.exposure_lines = exp_lines

@@ -68,6 +68,12 @@ _TRANSFER_GUARD_MODES = (None, "log", "disallow")
 # communication/compute overlap modes for the train step (ROADMAP #3)
 OVERLAP_MODES = ("off", "xla", "manual")
 
+# cross-slice gradient-sync modes on a hybrid multi-slice mesh
+# (ROADMAP #4; parallel/hierarchical.py) and the optional DCN-hop
+# compression arm
+DCN_SYNC_MODES = ("flat", "hier")
+DCN_COMPRESS_MODES = ("none", "bf16")
+
 # the compiler flags overlap="xla" applies on a TPU compile surface:
 # XLA's latency-hiding scheduler converts the FSDP all-gathers /
 # grad reduces into async start/done pairs and schedules independent
@@ -218,6 +224,25 @@ class ExecutionPlan:
     # (blockwise logsumexp accumulates in a different order).
     fused_ops: bool = False
 
+    # -- DCN-aware gradient sync (parallel/hierarchical.py) -------------
+    # cross-slice reduction shape on a multi-slice (num_slices > 1)
+    # hybrid mesh, via the manual overlap pipeline:
+    #   flat — the full gradient payload crosses the DCN link (GSPMD's
+    #          one-flat-all-reduce traffic shape)
+    #   hier — intra-slice reduce-scatter → cross-slice all-reduce over
+    #          the scattered shard (1/ici_size of the bytes over DCN)
+    #          → intra-slice all-gather. Bitwise-identical losses to
+    #          flat (both arms share the slice-staged accumulation
+    #          grouping); requires overlap="manual" (the hand-placed
+    #          collective pipeline) and downgrades LOUDLY to flat on
+    #          single-slice plans (no DCN hop to shrink — and the
+    #          no-op must not churn the compile fingerprint).
+    dcn_sync: str = "flat"
+    # "bf16" casts ONLY the hier DCN hop, with error feedback across
+    # the grad-accum scan — not bitwise; tolerance-pinned in
+    # tests/tolerances/hier_psum.json. Requires dcn_sync="hier".
+    dcn_compress: str = "none"
+
     # -- identity --------------------------------------------------------
     topology: str = "cpu-8"                   # key into CHIP_COUNTS
     budget_preset: Optional[str] = None       # tests/budgets/<name>.json
@@ -253,6 +278,40 @@ class ExecutionPlan:
         if self.overlap not in OVERLAP_MODES:
             raise PlanError(f"overlap={self.overlap!r} not in "
                             f"{OVERLAP_MODES}")
+        if self.dcn_sync not in DCN_SYNC_MODES:
+            raise PlanError(f"dcn_sync={self.dcn_sync!r} not in "
+                            f"{DCN_SYNC_MODES}")
+        if self.dcn_compress not in DCN_COMPRESS_MODES:
+            raise PlanError(f"dcn_compress={self.dcn_compress!r} not in "
+                            f"{DCN_COMPRESS_MODES}")
+        if self.dcn_sync == "hier" and self.num_slices <= 1:
+            # LOUD no-op downgrade, not a refusal: an elastic replan
+            # that collapses a 2-slice pool to one slice must keep its
+            # DCN_SYNC=hier env without dying — but the downgraded plan
+            # must fingerprint IDENTICALLY to flat (hier on one slice
+            # compiles the same program; a phantom fingerprint split
+            # would stale sidecars for nothing). Pinned by test.
+            import logging
+            logging.getLogger(__name__).warning(
+                "DCN_SYNC=hier on a single-slice plan (num_slices=1) is "
+                "a no-op — downgrading to flat (no DCN hop to shrink)")
+            object.__setattr__(self, "dcn_sync", "flat")
+            if self.dcn_compress != "none":
+                logging.getLogger(__name__).warning(
+                    "DCN_COMPRESS=%s downgraded to none with it (it "
+                    "compresses the hier DCN hop)", self.dcn_compress)
+                object.__setattr__(self, "dcn_compress", "none")
+        if self.dcn_sync == "hier" and self.overlap != "manual":
+            raise PlanError(
+                "dcn_sync='hier' needs overlap='manual' — the "
+                "hierarchical reduction is hand-placed by the manual "
+                "shard_map pipeline (train/overlap.py); GSPMD's own "
+                "gradient all-reduce cannot be decomposed from outside")
+        if self.dcn_compress != "none" and self.dcn_sync != "hier":
+            raise PlanError(
+                f"dcn_compress={self.dcn_compress!r} compresses the "
+                "hier cross-slice hop; set DCN_SYNC=hier (compressing "
+                "a full-payload flat hop is not supported)")
         if self.overlap == "manual":
             # the manual pipeline hand-places the fsdp collectives; the
             # structural axes would need their own manual collectives
@@ -636,6 +695,8 @@ CONFIG_KEYS: Dict[str, str] = {
     "obs_capture_budget": "OBS_CAPTURE_BUDGET",
     "overlap": "OVERLAP",
     "fused_ops": "FUSED_OPS",
+    "dcn_sync": "DCN_SYNC",
+    "dcn_compress": "DCN_COMPRESS",
     "topology": "TOPOLOGY",
     "budget_preset": "BUDGET_PRESET",
 }
@@ -660,8 +721,12 @@ _TRAIN_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     # program) and fused_ops swaps epilogue dispatches for Pallas
     # kernels — both change the compiled train executable, so sidecars
     # recorded under a different setting must stale (the OBS twin of
-    # this pin asserts the opposite: telemetry knobs are EXCLUDED)
-    "overlap", "fused_ops")
+    # this pin asserts the opposite: telemetry knobs are EXCLUDED).
+    # dcn_sync/dcn_compress reshape the manual pipeline's reduction
+    # collectives the same way — train-surface only (a serving replica
+    # decodes mesh-local; retuning the gradient sync must not stale
+    # serve sidecars — pinned by test like the OBS exclusion twin)
+    "overlap", "fused_ops", "dcn_sync", "dcn_compress")
 _SERVE_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     "max_batch", "decode_buckets", "serve_quant")
 COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
@@ -780,8 +845,9 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
         # driver-side `env OBS_DIR=...` must shape every rank's stream)
         "obs", "obs_dir", "obs_capture", "obs_capture_budget",
         # a driver-side `env OVERLAP=manual` / `FUSED_OPS=1` A/B must
-        # shape the program every worker compiles
-        "overlap", "fused_ops")))
+        # shape the program every worker compiles — and so must the
+        # DCN gradient-sync arms (`env DCN_SYNC=hier DCN_COMPRESS=bf16`)
+        "overlap", "fused_ops", "dcn_sync", "dcn_compress")))
 
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
@@ -832,6 +898,12 @@ def _coerce(field: str, value: Any) -> Any:
         # needs a disabling spelling (`env OVERLAP= python ...`)
         v = str(value).strip().lower()
         return "off" if v in ("", "0", "false", "no") else v
+    if field == "dcn_sync":
+        v = str(value).strip().lower()
+        return "flat" if v in ("", "0", "false", "no", "off") else v
+    if field == "dcn_compress":
+        v = str(value).strip().lower()
+        return "none" if v in ("", "0", "false", "no", "off") else v
     return value
 
 

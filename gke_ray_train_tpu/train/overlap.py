@@ -23,6 +23,20 @@ pipeline, the way Megatron-LM-style stacks hide their collectives:
   equivalence `tests/test_overlap.py` drills and ``BENCH_MODE=overlap``
   re-asserts per run.
 
+On a **multi-slice hybrid mesh** (``num_slices > 1`` — the data axis
+spans slices, PR 5's contract) the reduction is additionally
+DCN-aware (``parallel/hierarchical.py``): both ``DCN_SYNC`` arms stage
+the accumulation fold at the slice boundary (intra-slice partials
+first, the cross-slice combine second — the shared grouping that keeps
+flat-vs-hier **bitwise-identical**), and the arm picks the cross-slice
+payload: ``flat`` sends the full leaf over DCN (GSPMD's
+all-reduce-then-slice traffic shape), ``hier`` reduce-scatters over
+the intra-slice axes first so only ``1/ici_size`` of the bytes cross —
+the budgeted number ``tests/budgets/tiny_hybrid_2x4_*.json`` pins.
+``DCN_COMPRESS=bf16`` casts only the hier DCN hop, with error feedback
+carried across the grad-accumulation scan (not bitwise;
+tolerance-pinned in ``tests/tolerances/hier_psum.json``).
+
 Scope: data/fsdp meshes, dense blocks, full fine-tuning. The plan
 validator refuses ``overlap='manual'`` on structural-axis topologies
 (model/context/pipe > 1), and :func:`check_manual_support` refuses
@@ -116,15 +130,18 @@ def _leaf_fsdp_dims(spec, mesh: Mesh) -> Tuple[int, ...]:
     return tuple(out)
 
 
-def _fsdp_gather(x, dim: int):
+def _fsdp_gather(x, dim: int, shard_reduce=None):
     """All-gather one leaf over ``fsdp`` along ``dim`` — with a backward
-    that reproduces GSPMD's accumulation structure EXACTLY: one
-    all-reduce over the consecutive {data x fsdp} device group (the
-    ``[1,8]<=[8]`` form the GSPMD grad path emits), then the local fsdp
-    shard sliced out. The default AD transpose (``psum_scatter`` over
-    fsdp + a second psum over data) sums the same partials in a
-    different grouping, which costs the last ulp — and the bitwise
-    off/manual loss equivalence with it."""
+    that reproduces GSPMD's accumulation structure EXACTLY. Single
+    slice (``shard_reduce=None``): one all-reduce over the consecutive
+    {data x fsdp} device group (the ``[1,8]<=[8]`` form the GSPMD grad
+    path emits), then the local fsdp shard sliced out — the default AD
+    transpose (``psum_scatter`` over fsdp + a second psum over data)
+    sums the same partials in a different grouping, which costs the
+    last ulp and the bitwise off/manual loss equivalence with it.
+    Multi-slice: the slice-staged ``DCN_SYNC`` arm the caller passes as
+    ``shard_reduce(ct, dim) -> local shard``
+    (``parallel/hierarchical.py``)."""
     shard = x.shape[dim]
 
     @jax.custom_vjp
@@ -135,6 +152,8 @@ def _fsdp_gather(x, dim: int):
         return gather(x), None
 
     def bwd(_, ct):
+        if shard_reduce is not None:
+            return (shard_reduce(ct, dim),)
         full = jax.lax.psum(ct, _DP_AXES)
         idx = jax.lax.axis_index("fsdp") * shard
         return (jax.lax.dynamic_slice_in_dim(full, idx, shard, axis=dim),)
@@ -143,18 +162,18 @@ def _fsdp_gather(x, dim: int):
     return gather(x)
 
 
-def _gather_full(tree, spec_tree, mesh: Mesh):
+def _gather_full(tree, spec_tree, mesh: Mesh, shard_reduce=None):
     """Gather every sharded dim of every leaf (the non-block params:
     embed / lm_head / final norm)."""
     def one(x, spec):
         for dim in _leaf_fsdp_dims(spec, mesh):
-            x = _fsdp_gather(x, dim)
+            x = _fsdp_gather(x, dim, shard_reduce)
         return x
     return jtu.tree_map(one, tree, spec_tree,
                         is_leaf=lambda s: isinstance(s, P))
 
 
-def _gather_layer(blocks, block_specs, mesh: Mesh, i):
+def _gather_layer(blocks, block_specs, mesh: Mesh, i, shard_reduce=None):
     """Gather ONE layer of the stacked block leaves: dynamic-slice the
     repeat dim at (traced) index ``i``, then gather the fsdp dims. The
     leading stacked dim is the ``pipe`` axis (size 1 on these meshes)
@@ -164,7 +183,7 @@ def _gather_layer(blocks, block_specs, mesh: Mesh, i):
         for dim in _leaf_fsdp_dims(spec, mesh):
             if dim == 0:
                 continue
-            sl = _fsdp_gather(sl, dim)
+            sl = _fsdp_gather(sl, dim, shard_reduce)
         return sl
     return jtu.tree_map(one, blocks, block_specs,
                         is_leaf=lambda s: isinstance(s, P))
@@ -176,7 +195,7 @@ def _gather_layer(blocks, block_specs, mesh: Mesh, i):
 
 def _pipelined_hidden(full_nonblock: Params, blocks_local, cfg: ModelConfig,
                       mesh: Mesh, tokens, positions, segment_ids,
-                      fused_ops: bool):
+                      fused_ops: bool, shard_reduce=None):
     """tokens -> final hidden state, with the per-layer double-buffered
     fsdp gather. Per-layer math is :func:`run_block_stack` — the same
     function ``forward``'s scan body calls, so the two paths cannot
@@ -215,7 +234,7 @@ def _pipelined_hidden(full_nonblock: Params, blocks_local, cfg: ModelConfig,
                                 else None))
 
     R = cfg.n_repeats
-    cur0 = _gather_layer(blocks_local, block_specs, mesh, 0)
+    cur0 = _gather_layer(blocks_local, block_specs, mesh, 0, shard_reduce)
 
     def body(carry, i):
         x, aux, cur = carry
@@ -224,7 +243,8 @@ def _pipelined_hidden(full_nonblock: Params, blocks_local, cfg: ModelConfig,
         # released to this layer's compute — the double-buffer
         # discipline). The wrap-around gather of layer 0 on the last
         # iteration is carried out unused; its cotangent is zero.
-        nxt = _gather_layer(blocks_local, block_specs, mesh, (i + 1) % R)
+        nxt = _gather_layer(blocks_local, block_specs, mesh, (i + 1) % R,
+                            shard_reduce)
         nxt, x = _pin((nxt, x))
         layer_slice = jtu.tree_map(lambda v: v[0], cur)
         x, aux = run_block_stack(
@@ -247,7 +267,10 @@ def make_manual_grad_fn(cfg: ModelConfig, mesh: Mesh, *,
                         batch_keys: Tuple[str, ...] =
                         ("inputs", "targets", "weights"),
                         fused_ops: bool = False,
-                        use_fused_ce: bool = False):
+                        use_fused_ce: bool = False,
+                        num_slices: int = 1,
+                        dcn_sync: str = "flat",
+                        dcn_compress: str = "none"):
     """Build ``(params, micro) -> ((nll_sum, w_sum), grads)`` — the
     drop-in replacement for the GSPMD path's
     ``value_and_grad(micro_loss)`` that the accum scan consumes. The
@@ -255,11 +278,50 @@ def make_manual_grad_fn(cfg: ModelConfig, mesh: Mesh, *,
     arrive as the local param shards / local batch rows, the fsdp
     gathers and grad reductions are placed explicitly, and the outputs
     come back sharded exactly like the GSPMD grads (params-like tree +
-    replicated scalars)."""
+    replicated scalars).
+
+    ``num_slices``/``dcn_sync``/``dcn_compress``: the DCN-aware
+    reduction knobs (module docstring). With compression on, the
+    signature grows an error-feedback residual:
+    ``(params, micro, residual) -> ((nll, w), grads, new_residual)`` —
+    the residual tree is params-shaped (zeros at step start; the accum
+    scan in ``train/step.py`` carries it across microbatches) and the
+    returned fn carries ``grad_fn.compressed = True``."""
+    from gke_ray_train_tpu.parallel.hierarchical import (
+        compressed_cross_psum, flat_reduce_shard, hier_reduce_full,
+        hier_reduce_shard, intra_reduce_shard, slice_topology,
+        staged_psum)
+
     check_manual_support(cfg, mesh)
     specs = param_specs(cfg)
+    topo = slice_topology(mesh, num_slices)
+    compressed = dcn_compress != "none" and topo is not None
+    if dcn_sync == "hier" and topo is None:
+        # a loud no-op: single-slice pools have no DCN hop to shrink
+        # (plan validation already downgraded a declared NUM_SLICES=1
+        # hier; this catches direct callers)
+        dcn_sync = "flat"
 
-    def local_grad(params_local, micro_local):
+    # the sharded-leaf reduction _fsdp_gather's backward applies:
+    #   single slice      — None (the joint psum + slice, unchanged)
+    #   flat  multi-slice — staged full payload over DCN
+    #   hier  multi-slice — scattered shard over DCN (1/ici_size)
+    #   compressed        — intra-slice half only; the DCN hop runs
+    #                       after value_and_grad, with the residual
+    if topo is None:
+        shard_reduce = None
+    elif compressed:
+        shard_reduce = lambda ct, dim: intra_reduce_shard(ct, topo, dim)  # noqa: E731
+    elif dcn_sync == "hier":
+        shard_reduce = lambda ct, dim: hier_reduce_shard(ct, topo, dim)  # noqa: E731
+    else:
+        shard_reduce = lambda ct, dim: flat_reduce_shard(ct, topo, dim)  # noqa: E731
+
+    def _scalar_sum(x):
+        return jax.lax.psum(x, _DP_AXES) if topo is None \
+            else staged_psum(x, topo)
+
+    def local_grad(params_local, micro_local, resid_local=None):
         B_loc, S = micro_local["inputs"].shape
         positions = micro_local.get("positions")
         if positions is None:
@@ -270,10 +332,10 @@ def make_manual_grad_fn(cfg: ModelConfig, mesh: Mesh, *,
         def loss_fn(p):
             nonblock = {k: v for k, v in p.items() if k != "blocks"}
             nb_specs = {k: v for k, v in specs.items() if k != "blocks"}
-            full_nb = _gather_full(nonblock, nb_specs, mesh)
+            full_nb = _gather_full(nonblock, nb_specs, mesh, shard_reduce)
             x = _pipelined_hidden(full_nb, p["blocks"], cfg, mesh,
                                   micro_local["inputs"], positions,
-                                  segment_ids, fused_ops)
+                                  segment_ids, fused_ops, shard_reduce)
             dtype = jnp.dtype(cfg.dtype)
             if use_fused_ce and cfg.logit_softcap is None:
                 from gke_ray_train_tpu.ops.fused_ce import \
@@ -293,27 +355,61 @@ def make_manual_grad_fn(cfg: ModelConfig, mesh: Mesh, *,
         (nll, w), g = jax.value_and_grad(loss_fn, has_aux=True)(
             params_local)
 
+        if compressed:
+            # sharded leaves arrive as intra-slice partials; the DCN
+            # hop runs here, bf16 with the error-feedback residual.
+            # Replicated leaves (norms — a rounding error of bytes)
+            # ride the uncompressed hier hop; their residual stays 0.
+            def hop(gl, rl, spec):
+                if _leaf_fsdp_dims(spec, mesh):
+                    return compressed_cross_psum(gl, rl, topo,
+                                                 dcn_compress)
+                return hier_reduce_full(gl, topo), rl
+
+            paired = jtu.tree_map(hop, g, resid_local, specs,
+                                  is_leaf=lambda s: isinstance(s, P))
+            is_pair = lambda x: isinstance(x, tuple)  # noqa: E731
+            g = jtu.tree_map(lambda p: p[0], paired, is_leaf=is_pair)
+            new_resid = jtu.tree_map(lambda p: p[1], paired,
+                                     is_leaf=is_pair)
+            return (g, _scalar_sum(nll), _scalar_sum(w), new_resid)
+
         def reduce_leaf(gl, spec):
             # gathered leaves were already reduced over BOTH axes by
             # _fsdp_gather's backward; replicated leaves (norms,
             # biases) still need the cross-device sum
             if _leaf_fsdp_dims(spec, mesh):
                 return gl
-            return jax.lax.psum(gl, _DP_AXES)
+            if topo is None:
+                return jax.lax.psum(gl, _DP_AXES)
+            return (hier_reduce_full(gl, topo) if dcn_sync == "hier"
+                    else staged_psum(gl, topo))
 
         g = jtu.tree_map(reduce_leaf, g, specs,
                          is_leaf=lambda s: isinstance(s, P))
-        return g, jax.lax.psum(nll, _DP_AXES), jax.lax.psum(w, _DP_AXES)
+        return g, _scalar_sum(nll), _scalar_sum(w)
 
     batch_specs = {k: P(_DP_AXES, None) for k in batch_keys}
-    mapped = shard_map(local_grad, mesh=mesh,
-                       in_specs=(specs, batch_specs),
-                       out_specs=(specs, P(), P()),
-                       check_vma=False)
+    if compressed:
+        mapped = shard_map(local_grad, mesh=mesh,
+                           in_specs=(specs, batch_specs, specs),
+                           out_specs=(specs, P(), P(), specs),
+                           check_vma=False)
 
-    @functools.wraps(local_grad)
-    def grad_fn(params: Params, micro: Dict[str, Any]):
-        g, nll, w = mapped(params, micro)
-        return (nll, w), g
+        @functools.wraps(local_grad)
+        def grad_fn(params: Params, micro: Dict[str, Any], residual):
+            g, nll, w, new_resid = mapped(params, micro, residual)
+            return (nll, w), g, new_resid
+    else:
+        mapped = shard_map(local_grad, mesh=mesh,
+                           in_specs=(specs, batch_specs),
+                           out_specs=(specs, P(), P()),
+                           check_vma=False)
 
+        @functools.wraps(local_grad)
+        def grad_fn(params: Params, micro: Dict[str, Any]):
+            g, nll, w = mapped(params, micro)
+            return (nll, w), g
+
+    grad_fn.compressed = compressed
     return grad_fn

@@ -248,7 +248,15 @@ def make_train_step(cfg: ModelConfig,
             cfg, mesh,
             batch_keys=(plan.batch_keys() if plan is not None
                         else ("inputs", "targets", "weights")),
-            fused_ops=fused_ops, use_fused_ce=fused_ce)
+            fused_ops=fused_ops, use_fused_ce=fused_ce,
+            # DCN-aware gradient sync (parallel/hierarchical.py): on a
+            # multi-slice plan the reduction stages at the slice
+            # boundary; DCN_SYNC picks the cross-slice payload and
+            # DCN_COMPRESS=bf16 casts the hier hop with error feedback
+            num_slices=plan.num_slices if plan is not None else 1,
+            dcn_sync=plan.dcn_sync if plan is not None else "flat",
+            dcn_compress=(plan.dcn_compress if plan is not None
+                          else "none"))
 
     def micro_loss(trainable: Params, frozen: Params, micro: Batch,
                    drop_rng=None):
@@ -308,9 +316,22 @@ def make_train_step(cfg: ModelConfig,
                 jax.random.fold_in(jax.random.key(0), state.step),
                 grad_accum)
 
+        dcn_residual = manual_grad is not None \
+            and getattr(manual_grad, "compressed", False)
+
         def accum(carry, xs):
             micro = xs[0]
             drop_rng = xs[1] if drop_rngs is not None else None
+            if dcn_residual:
+                g_acc, nll_acc, w_acc, resid = carry
+                # compressed DCN hop with error feedback: microbatch
+                # k's bf16 quantization residual feeds microbatch
+                # k+1's pre-quantization value (train/overlap.py);
+                # the step-final residual is dropped with the carry
+                (nll, w), g, resid = manual_grad(trainable, micro,
+                                                 resid)
+                return (jax.tree.map(jnp.add, g_acc, g),
+                        nll_acc + nll, w_acc + w, resid), None
             g_acc, nll_acc, w_acc = carry
             if manual_grad is not None:
                 # the shard_map microbatch pipeline (train/overlap.py):
@@ -326,9 +347,15 @@ def make_train_step(cfg: ModelConfig,
 
         zeros = jax.tree.map(jnp.zeros_like, trainable)
         scan_xs = (micros,) if drop_rngs is None else (micros, drop_rngs)
-        (g_sum, nll_sum, w_sum), _ = jax.lax.scan(
-            accum, (zeros, jnp.zeros((), jnp.float32),
-                    jnp.zeros((), jnp.float32)), scan_xs)
+        carry0 = (zeros, jnp.zeros((), jnp.float32),
+                  jnp.zeros((), jnp.float32))
+        if dcn_residual:
+            # the residual is params-shaped (sharded leaves carry the
+            # DCN-hop error at local-shard granularity) and zeroed per
+            # step — no TrainState change, no checkpoint-layout change
+            carry0 = carry0 + (jax.tree.map(jnp.zeros_like, trainable),)
+        (g_sum, nll_sum, w_sum, *_), _ = jax.lax.scan(
+            accum, carry0, scan_xs)
 
         inv_w = jnp.where(w_sum > 0, 1.0 / w_sum, 0.0)
         grads = jax.tree.map(lambda g: (g * inv_w).astype(g.dtype), g_sum)

@@ -46,6 +46,14 @@ run serve          env BENCH_MODE=serve python bench.py
 # bytes), the half of the claim that survives a dead backend
 run overlap        env BENCH_MODE=overlap python bench.py
 
+# DCN gradient-sync A/B (parallel/hierarchical.py, plan knobs
+# DCN_SYNC/DCN_COMPRESS) on the emulated 2-slice hybrid mesh (re-execs
+# onto the canonical 8-fake-device CPU mesh): flat vs hier cross-slice
+# reduction — the record asserts bitwise-identical loss streams and
+# carries each arm's ici_bytes/dcn_bytes/overlap_frac; value = the
+# DCN traffic shrink factor (~= ici_size)
+run dcn            env BENCH_MODE=dcn python bench.py
+
 # fault-tolerance drill: time-to-recover (injected kill -> first
 # post-resume step) + checkpoint-save latency under SIGTERM (must fit
 # the preemption grace window); the record splits recompile time from

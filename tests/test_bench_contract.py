@@ -54,6 +54,45 @@ def test_goodput_ledger_schema_pinned():
     assert "LEDGER_TERMS" in src
 
 
+def test_bench_dcn_mode_registered():
+    """BENCH_MODE=dcn is in the dispatch registry and its record pins
+    the per-arm network fields (the fast half of the schema pin; the
+    slow half runs the subprocess)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"dcn": bench_dcn' in src
+    for field in ("losses_bitwise_equal", "dcn_bytes_flat",
+                  "dcn_bytes_hier", "dcn_bytes_compressed",
+                  "ici_bytes_flat", "ici_bytes_hier",
+                  "overlap_frac_flat", "overlap_frac_hier"):
+        assert f'"{field}"' in src, field
+
+
+@pytest.mark.slow
+def test_bench_dcn_record_shape():
+    """BENCH_MODE=dcn emits ONE valid record: bitwise flat-vs-hier
+    loss streams asserted on-record, per-arm ici/dcn bytes, and the
+    DCN shrink factor as the value (~ici_size on the 2x4 mesh)."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
+    env.update(BENCH_MODE="dcn", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO, COMPILE_CACHE="0")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["losses_bitwise_equal"] is True
+    assert rec["compressed_within_5pct"] is True
+    assert rec["dcn_bytes_hier"] < rec["dcn_bytes_flat"]
+    assert rec["dcn_bytes_compressed"] < rec["dcn_bytes_hier"]
+    # value = the DCN shrink factor; ici_size = 4 on the 2x4 mesh
+    assert 3.0 <= rec["value"] <= 4.5
+    assert rec["unit"] == "x"
+    assert rec["plan_fingerprint"]
+
+
 @pytest.mark.slow
 def test_bench_elastic_record_shape():
     """BENCH_MODE=elastic emits one valid tagged record whose goodput
