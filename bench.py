@@ -107,6 +107,25 @@ def _timed_loop(run_steps, steps: int, latency: float):
     return dt_get, dt_block
 
 
+# one stable id per bench process: records emitted OUTSIDE an obs
+# session (the common bench path) still need a run identity, so `obs
+# diff` / the report merge can key A/B arms deterministically instead
+# of by file order. An active session's OBS_RUN_ID (exported by the
+# trainer, or job-level env) always wins — those records must join the
+# run's event stream under the same key.
+_BENCH_RUN_ID = None
+
+
+def _bench_run_id():
+    global _BENCH_RUN_ID
+    if os.environ.get("OBS_RUN_ID"):
+        return os.environ["OBS_RUN_ID"]
+    if _BENCH_RUN_ID is None:
+        from gke_ray_train_tpu.obs.runtime import new_run_id
+        _BENCH_RUN_ID = new_run_id()
+    return _BENCH_RUN_ID
+
+
 def _emit(metric, value, unit, extra, compare_baseline=True):
     baseline_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "bench_baseline.json")
@@ -124,6 +143,7 @@ def _emit(metric, value, unit, extra, compare_baseline=True):
         "metric": metric,
         "value": round(value, 1),
         "unit": unit,
+        "run_id": _bench_run_id(),
         "vs_baseline": round(value / baseline, 3) if baseline else 1.0,
         # provenance: a CPU-fallback record must never masquerade as an
         # accelerator number (the r4-r5 BENCH gap was error JSONs; the

@@ -23,18 +23,34 @@ __version__ = "0.1.0"
 # itself in parallel.sharding.sharding_invariant_rng (partitionable
 # threefry, scoped — the global flag costs ~15% wall on CPU suites).
 
-from gke_ray_train_tpu.parallel.mesh import (  # noqa: F401
-    MeshConfig,
-    build_mesh,
-    batch_sharding,
-    AXIS_DATA,
-    AXIS_FSDP,
-    AXIS_MODEL,
-    AXIS_CONTEXT,
-    AXIS_PIPE,
-    MESH_AXES,
-)
-from gke_ray_train_tpu.plan import (  # noqa: F401
-    ExecutionPlan,
-    compile_step_with_plan,
-)
+# The package re-exports are LAZY (PEP 562): parallel.mesh imports jax
+# at module level, but the obs/ CLI surface (`python -m
+# gke_ray_train_tpu.obs report|diff|schema`) is stdlib-only by
+# contract — it must run on a laptop pointed at a GCS-FUSE mount with
+# no jax installed, and importing any submodule materializes this
+# __init__ first. Attribute access (`gke_ray_train_tpu.MeshConfig`)
+# resolves exactly as before.
+_LAZY_EXPORTS = {
+    "MeshConfig": "parallel.mesh",
+    "build_mesh": "parallel.mesh",
+    "batch_sharding": "parallel.mesh",
+    "AXIS_DATA": "parallel.mesh",
+    "AXIS_FSDP": "parallel.mesh",
+    "AXIS_MODEL": "parallel.mesh",
+    "AXIS_CONTEXT": "parallel.mesh",
+    "AXIS_PIPE": "parallel.mesh",
+    "MESH_AXES": "parallel.mesh",
+    "ExecutionPlan": "plan",
+    "compile_step_with_plan": "plan",
+}
+
+__all__ = sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _LAZY_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

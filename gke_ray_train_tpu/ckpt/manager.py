@@ -19,6 +19,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -277,6 +278,7 @@ class CheckpointManager:
         for step in steps:
             out = err = None
             restored = False
+            t_restore0 = time.perf_counter()
             for restore_try in range(2):
                 try:
                     out = self.restore(state_like, step)
@@ -331,6 +333,13 @@ class CheckpointManager:
                 obs_runtime.emit("reshard", step=step,
                                  from_devices=int(note["n_devices"]),
                                  to_devices=cur_n)
+                # the restore-level half of the reshard span twin pair
+                # (rayint/elastic.py spans the plan re-formation): how
+                # long the RESHARDED restore itself took
+                obs_runtime.span_add(
+                    "reshard", time.perf_counter() - t_restore0,
+                    step=step, from_devices=int(note["n_devices"]),
+                    to_devices=cur_n, where="restore")
             logger.info("resuming from checkpoint step %d in %s", step,
                         self.directory)
             return out, step

@@ -48,11 +48,13 @@ PLAN_SCOPED_KEYS = frozenset({
     # serving shape (serve/engine.py): slot count, length buckets,
     # served-weight quantization
     "MAX_BATCH", "DECODE_BUCKETS", "SERVE_QUANT",
-    # observability (obs/): unified telemetry on/off + dir, and the
-    # anomaly-triggered profiler capture policy. Operational knobs —
-    # never compile-relevant (toggling telemetry must not stale a
-    # sidecar; plan.COMPILE_SURFACES excludes them).
-    "OBS", "OBS_DIR", "OBS_CAPTURE", "OBS_CAPTURE_BUDGET",
+    # observability (obs/): unified telemetry on/off + dir, the
+    # anomaly-triggered profiler capture policy, and causal span
+    # tracing (obs/trace.py — per-rank span streams, critical-path
+    # attribution in `obs report`). Operational knobs — never
+    # compile-relevant (toggling telemetry must not stale a sidecar;
+    # plan.COMPILE_SURFACES excludes them).
+    "OBS", "OBS_DIR", "OBS_CAPTURE", "OBS_CAPTURE_BUDGET", "TRACE",
     # kernel & overlap execution path (ROADMAP #3): OVERLAP picks the
     # collective-hiding mode (off | xla | manual), FUSED_OPS routes the
     # memory-bound epilogues through the fused Pallas kernels. Both are

@@ -1,14 +1,21 @@
-"""obs/ — unified run telemetry (ISSUE 11).
+"""obs/ — unified run telemetry (ISSUE 11) + causal tracing (ISSUE 14).
 
 - ``events``  — structured per-rank JSONL event stream (pinned schema)
 - ``metrics`` — counters/gauges/histograms + Prometheus/JSON exporters
 - ``capture`` — anomaly-triggered one-shot ``jax.profiler`` captures
+- ``trace``   — causal spans (pinned schema): the ledger-timed attempt
+  boundaries + serve request lifecycles, one trace per run
+- ``critical``— critical-path attribution over the merged span DAG,
+  reconciled against the goodput ledger
 - ``runtime`` — the per-process session everything emits through
 - ``report``  — one merged, reconciled report per run
   (CLI: ``python -m gke_ray_train_tpu.obs report <run_dir>``)
+- ``diff``    — the cross-run regression gate
+  (CLI: ``python -m gke_ray_train_tpu.obs diff <A> <B>``; checked-in
+  ledgers under ``tests/regressions/``)
 
-Stdlib-only at import: the driver, the supervisor, and the report run
-without jax.
+Stdlib-only at import: the driver, the supervisor, the report and the
+diff run without jax.
 """
 
 from gke_ray_train_tpu.obs.events import (  # noqa: F401
@@ -16,4 +23,7 @@ from gke_ray_train_tpu.obs.events import (  # noqa: F401
 from gke_ray_train_tpu.obs.metrics import (  # noqa: F401
     METRIC_NAMES, MetricsRegistry)
 from gke_ray_train_tpu.obs.runtime import (  # noqa: F401
-    active, emit, registry, resolve_obs_dir, start_attempt, end_attempt)
+    active, emit, registry, resolve_obs_dir, span_add, start_attempt,
+    end_attempt, tracing)
+from gke_ray_train_tpu.obs.trace import (  # noqa: F401
+    SPAN_NAMES, SPAN_STAMP, SpanLog, iter_spans, validate_span)

@@ -113,11 +113,21 @@ class Gauge:
 
 
 class Histogram:
-    """count/sum plus a bounded sample for p50/p99 — enough for the
-    serving-latency shape without a streaming-quantile dependency.
-    Past ``max_samples`` new observations overwrite a rotating slot
-    (deterministic, no RNG on the hot path)."""
-    __slots__ = ("name", "count", "sum", "_samples", "_max", "_i")
+    """count/sum plus a bounded RESERVOIR sample for p50/p99 — enough
+    for the serving-latency shape without a streaming-quantile
+    dependency.
+
+    Past ``max_samples`` the sample is maintained by Vitter's
+    Algorithm R: observation ``n`` replaces a random slot with
+    probability ``max_samples/n``, so the retained sample stays a
+    uniform draw over the WHOLE run. The previous rotating-slot scheme
+    kept only the most recent window, so a long run's p50/p99 silently
+    forgot every earlier regime (and the scheme before that stopped
+    admitting entirely — quantiles frozen on the run's first minutes).
+    The "randomness" is a fixed-seed 64-bit LCG: two integer ops per
+    observation, deterministic across runs, no RNG machinery on the
+    hot path."""
+    __slots__ = ("name", "count", "sum", "_samples", "_max", "_rng")
 
     def __init__(self, name: str, max_samples: int = 2048):
         self.name = name
@@ -125,7 +135,10 @@ class Histogram:
         self.sum = 0.0
         self._samples: List[float] = []
         self._max = max_samples
-        self._i = 0
+        # deterministic per-instrument seed (name-derived, stable)
+        self._rng = (0x9E3779B97F4A7C15
+                     ^ int.from_bytes(name.encode()[:8].ljust(8, b"\0"),
+                                      "little")) & 0xFFFFFFFFFFFFFFFF
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -134,8 +147,12 @@ class Histogram:
         if len(self._samples) < self._max:
             self._samples.append(value)
         else:
-            self._samples[self._i] = value
-            self._i = (self._i + 1) % self._max
+            # Algorithm R with an inline LCG (Knuth MMIX constants)
+            self._rng = (self._rng * 6364136223846793005
+                         + 1442695040888963407) & 0xFFFFFFFFFFFFFFFF
+            j = (self._rng >> 11) % self.count
+            if j < self._max:
+                self._samples[j] = value
 
     def quantile(self, q: float) -> float:
         if not self._samples:

@@ -25,7 +25,9 @@ import logging
 import os
 from typing import Any, Dict, List, Optional
 
+from gke_ray_train_tpu.obs import critical as critical_mod
 from gke_ray_train_tpu.obs.events import iter_events
+from gke_ray_train_tpu.obs.trace import iter_spans
 
 logger = logging.getLogger(__name__)
 
@@ -125,11 +127,13 @@ def build_report(run_dir: str) -> Dict[str, Any]:
     attempts: List[Dict[str, Any]] = []
     for i, end in enumerate(sorted(ends, key=lambda e: e["ts"]), 1):
         n = int(end.get("attempt") or i)
+        end_run_id = end.get("run_id")
         evs = att_events.get(n, [])
         t0 = min((e["ts"] for e in evs), default=end["ts"])
         goodput = end.get("goodput")
         att: Dict[str, Any] = {
             "attempt": n,
+            "run_id": end_run_id,
             "status": end.get("status"),
             "plan_fingerprint": end.get("plan_fingerprint"),
             "resumed_step": end.get("resumed_step"),
@@ -202,6 +206,54 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         if doc is not None:
             metrics[rank] = doc
 
+    # -- causal spans (obs/trace.py) -> per-attempt critical path ------
+    # grouped by (run_id, attempt), NOT attempt alone: span/event files
+    # open in append mode, so a reused obs dir (the run-stable default
+    # <output>/obs) holds several runs' streams — merging run A's and
+    # run B's attempt-1 spans would double-count terms against one
+    # ledger and flip the rc=3 gate on perfectly healthy telemetry
+    spans = list(iter_spans(obs_dir))
+    spans_by_attempt: Dict[tuple, List[dict]] = {}
+    for s in spans:
+        spans_by_attempt.setdefault(
+            (s.get("run_id"), int(s.get("attempt") or 0)), []).append(s)
+    # per-rank worker ledgers: the span/ledger reconciliation runs
+    # against the CRITICAL rank's own books (worker_exit carries one
+    # per rank), not rank 0's
+    rank_ledgers: Dict[tuple, Dict[Any, dict]] = {}
+    for e in events:
+        if e["kind"] == "worker_exit" and isinstance(e.get("goodput"),
+                                                     dict):
+            rank_ledgers.setdefault(
+                (e.get("run_id"), int(e.get("attempt") or 0)),
+                {})[e.get("rank")] = e["goodput"]
+    critical_ok = True
+    for att in attempts:
+        key = (att.get("run_id"), att["attempt"])
+        sp = spans_by_attempt.get(key)
+        if not sp:
+            continue
+        cp = critical_mod.critical_path(
+            sp, att.get("goodput"), rank_ledgers.get(key))
+        if cp is not None:
+            att["critical_path"] = cp
+            critical_ok = critical_ok and cp["reconciliation"]["ok"]
+    trace_section = None
+    if spans:
+        # same reused-dir discipline as the critical path above: the
+        # headline trace section describes ONE run — the newest by
+        # span end time — never a cross-run mixture (the serve
+        # "slowest request" of run A must not label run B's report)
+        newest_run = max(spans, key=lambda s: s.get("t1", 0.0)) \
+            .get("run_id")
+        tr_spans = [s for s in spans if s.get("run_id") == newest_run]
+        trace_section = {
+            "trace_id": tr_spans[0].get("trace_id"),
+            "span_count": len(tr_spans),
+            "runs_in_dir": len({s.get("run_id") for s in spans}),
+            "serve": critical_mod.serve_summary(tr_spans),
+        }
+
     reconciled = all(a["reconciliation"]["ok"] for a in attempts
                      if a["reconciliation"] is not None)
     totals: Dict[str, float] = {}
@@ -236,6 +288,12 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         "goodput": totals or None,
         "network": network or None,
         "reconciled": reconciled,
+        # span/ledger cross-stream verification (obs/critical.py):
+        # True when no attempt has spans, or every attempt's span-
+        # derived terms match its rank's ledger — the CLI exits 3 on
+        # False, the same teeth as the ledger identity above
+        "critical_path_ok": critical_ok,
+        "trace": trace_section,
         "anomalies": [{k: a.get(k) for k in
                        ("attempt", "rank", "class", "trigger_step",
                         "detail", "captured")} for a in anomalies],
@@ -284,6 +342,20 @@ def render_text(report: Dict[str, Any]) -> str:
                 L.append(f"  !! ledger does NOT reconcile: terms sum "
                          f"{rec['terms_sum_s']:.4f}s vs wall "
                          f"{rec['wall_s']:.4f}s")
+        cp = a.get("critical_path")
+        if cp:
+            terms = cp.get("terms") or {}
+            cw = cp.get("wall_s") or terms.get("wall_s") or 1.0
+            flame = " | ".join(
+                f"{t[:-2]} {terms.get(t, 0.0):.2f}s"
+                f"({terms.get(t, 0.0) / cw:.0%})"
+                for t in LEDGER_TERMS
+                if terms.get(t, 0.0) > max(0.005 * cw, 0.0005))
+            crec = cp.get("reconciliation") or {}
+            L.append(f"  critical path r{cp['rank']}: {flame}"
+                     + ("" if crec.get("ok")
+                        else "  !! SPANS DO NOT MATCH LEDGER "
+                             f"(deltas {crec.get('deltas')})"))
         for e in a["timeline"]:
             extras = {k: v for k, v in e.items()
                       if k not in ("t", "rank", "step", "kind")
@@ -304,6 +376,19 @@ def render_text(report: Dict[str, Any]) -> str:
         for c in report["captures"]:
             L.append(f"  {c['class']} @ step {c['trigger_step']}: "
                      f"{c['artifact']}")
+    tr = report.get("trace")
+    if tr:
+        L.append(f"trace {tr['trace_id']}: {tr['span_count']} spans")
+        sv = tr.get("serve")
+        if sv:
+            ex = sv.get("slowest") or {}
+            L.append(
+                f"  serve: {sv['requests']} request(s), slowest "
+                f"{ex.get('rid')} = {ex.get('total_s', 0.0):.3f}s "
+                f"(enqueue {ex.get('enqueue_s', 0.0):.3f}s, prefill "
+                f"{ex.get('prefill_s', 0.0):.3f}s, decode "
+                f"{ex.get('decode_s', 0.0):.3f}s / "
+                f"{ex.get('iterations')} iter)")
     sup = report.get("supervisor")
     if sup and sup.get("stalled"):
         L.append(f"supervisor: stalled ranks {sup['stalled']}")

@@ -34,11 +34,19 @@ from gke_ray_train_tpu.obs import metrics as metrics_mod
 from gke_ray_train_tpu.obs.events import EventLog, events_path
 from gke_ray_train_tpu.obs.metrics import (
     MetricsRegistry, export_serve_stats, pull_jax_counters)
+from gke_ray_train_tpu.obs import trace as trace_mod
+from gke_ray_train_tpu.obs.trace import SpanLog, new_span_id, spans_path
 
 logger = logging.getLogger(__name__)
 
 RUN_ID_ENV = "OBS_RUN_ID"
 ATTEMPT_ENV = "OBS_ATTEMPT"
+# the causal parent of this process's attempt span (obs/trace.py): the
+# driver mints one span id per attempt and forwards it to every worker
+# through the same env path as the run/attempt identity, so worker
+# attempt spans parent under the driver's and the merged cross-rank
+# span DAG is connected
+PARENT_SPAN_ENV = "OBS_PARENT_SPAN"
 
 _active: Optional["ObsRun"] = None
 
@@ -54,6 +62,12 @@ def _knob(name: str, config: Optional[dict], default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _truthy(raw) -> bool:
+    """The one falsey-spelling set for every default-on obs knob
+    (OBS / OBS_CAPTURE / TRACE) — four call sites, one dialect."""
+    return str(raw).strip().lower() not in ("0", "false", "no", "off")
+
+
 def resolve_obs_dir(plan=None, config: Optional[dict] = None
                     ) -> Optional[str]:
     """The obs dir for this run, or None (= obs off). Precedence:
@@ -66,8 +80,7 @@ def resolve_obs_dir(plan=None, config: Optional[dict] = None
         enabled = bool(getattr(plan, "obs", True))
         explicit = getattr(plan, "obs_dir", None)
     else:
-        v = str(config.get("OBS", os.environ.get("OBS", "1")))
-        enabled = v.strip().lower() not in ("0", "false", "no", "off")
+        enabled = _truthy(config.get("OBS", os.environ.get("OBS", "1")))
         explicit = config.get("OBS_DIR", os.environ.get("OBS_DIR"))
     if not enabled:
         return None
@@ -90,7 +103,7 @@ class ObsRun:
     def __init__(self, obs_dir: str, *, run_id: str, attempt: int,
                  rank: Union[int, str], slice_index: Optional[int],
                  plan_fingerprint: Optional[str],
-                 capture=None):
+                 capture=None, trace: bool = True):
         self.obs_dir = obs_dir
         self.rank = rank
         self.events = EventLog(events_path(obs_dir, rank),
@@ -102,6 +115,20 @@ class ObsRun:
             **({"slice": str(slice_index)}
                if slice_index is not None else {})})
         self.capture = capture
+        # causal span stream (obs/trace.py): one attempt span per
+        # session, parented under the driver's (OBS_PARENT_SPAN) when
+        # one exists; leaf spans default-parent under the attempt span.
+        # The span is OPENED here and written at finish() — a killed
+        # attempt simply never lands it, which is itself the signal.
+        self.spans: Optional[SpanLog] = None
+        self.attempt_span_id: Optional[str] = None
+        self._attempt_parent = os.environ.get(PARENT_SPAN_ENV) or None
+        self._attempt_t0 = time.time()
+        if trace:
+            self.spans = SpanLog(spans_path(obs_dir, rank),
+                                 run_id=run_id, attempt=attempt,
+                                 rank=rank, slice_index=slice_index)
+            self.attempt_span_id = new_span_id()
         self._closed = False
 
     # -- loop hooks (hot-path budget: host floats only) ----------------
@@ -162,6 +189,16 @@ class ObsRun:
         pull_jax_counters(self.registry)
         self.emit("worker_exit", status=status, goodput=ledger)
         self.export()
+        if self.spans is not None:
+            now = time.time()
+            try:
+                self.spans.emit("attempt", now - self._attempt_t0,
+                                t1=now, span_id=self.attempt_span_id,
+                                parent_id=self._attempt_parent,
+                                status=status)
+            except Exception as e:  # noqa: BLE001 - IO best-effort
+                logger.warning("obs attempt span dropped: %s", e)
+            self.spans.close()
         self.events.close()
         self._closed = True
 
@@ -175,6 +212,33 @@ class ObsRun:
             raise            # schema violations are bugs, not telemetry
         except Exception as e:  # noqa: BLE001 - IO must not kill a run
             logger.warning("obs event %s dropped: %s", kind, e)
+
+    def span_add(self, name: str, dur_s: float, *,
+                 t1: Optional[float] = None,
+                 step: Optional[int] = None,
+                 parent_id: Optional[str] = None,
+                 span_id: Optional[str] = None,
+                 **attrs: Any) -> Optional[str]:
+        """Record one finished leaf span (obs/trace.py), parented under
+        this attempt's span unless told otherwise. ``dur_s`` is the
+        caller's own measurement — instrumented sites pass the exact
+        float the goodput ledger booked, which is what lets
+        ``obs/critical.py`` reconcile the two streams exactly. Returns
+        the span id (for child spans), or None when tracing is off."""
+        if self.spans is None:
+            return None
+        try:
+            rec = self.spans.emit(
+                name, dur_s, t1=t1, step=step, span_id=span_id,
+                parent_id=(parent_id if parent_id is not None
+                           else self.attempt_span_id),
+                **attrs)
+            return rec["span_id"]
+        except trace_mod.SpanError:
+            raise            # schema violations are bugs, not telemetry
+        except Exception as e:  # noqa: BLE001 - IO must not kill a run
+            logger.warning("obs span %s dropped: %s", name, e)
+            return None
 
     def export(self) -> None:
         try:
@@ -207,6 +271,21 @@ def note_cost_report(report) -> None:
     unconfigured, like :func:`emit`."""
     if _active is not None:
         _active.note_cost_report(report)
+
+
+def span_add(name: str, dur_s: float, **kw: Any) -> Optional[str]:
+    """Module-level twin of :meth:`ObsRun.span_add` — the one line
+    every instrumented module calls; no-op (None) when no session is
+    configured or tracing is off."""
+    if _active is not None:
+        return _active.span_add(name, dur_s, **kw)
+    return None
+
+
+def tracing() -> bool:
+    """True when the active session records spans — lets hot-ish call
+    sites skip building attr dicts for nothing."""
+    return _active is not None and _active.spans is not None
 
 
 def start_attempt(plan=None, config: Optional[dict] = None, *,
@@ -242,13 +321,12 @@ def start_attempt(plan=None, config: Optional[dict] = None, *,
     if plan is not None:        # validated fields
         cap_on = bool(getattr(plan, "obs_capture", True))
         budget = int(getattr(plan, "obs_capture_budget", 4))
+        trace_on = bool(getattr(plan, "trace", True))
     else:
         # config key wins over env, and a malformed value DEGRADES
         # with a warning — telemetry knobs must never kill an attempt
         # (the ELASTIC_N_DEVICES convention)
-        raw = _knob("OBS_CAPTURE", config, "1")
-        cap_on = str(raw).strip().lower() not in ("0", "false", "no",
-                                                  "off")
+        cap_on = _truthy(_knob("OBS_CAPTURE", config, "1"))
         raw = _knob("OBS_CAPTURE_BUDGET", config, "4")
         try:
             budget = int(raw)
@@ -256,8 +334,10 @@ def start_attempt(plan=None, config: Optional[dict] = None, *,
             logger.warning("OBS_CAPTURE_BUDGET=%r is not an int; "
                            "using 4", raw)
             budget = 4
+        trace_on = _truthy(_knob("TRACE", config, "1"))
     run = ObsRun(obs_dir, run_id=run_id, attempt=attempt, rank=rank,
-                 slice_index=slice_index, plan_fingerprint=fp)
+                 slice_index=slice_index, plan_fingerprint=fp,
+                 trace=trace_on)
     if cap_on:
         from gke_ray_train_tpu.obs.capture import CaptureManager
         capture = CaptureManager(obs_dir, emit_fn=run.emit,
@@ -310,13 +390,37 @@ def _rank_slice(rank: int, config: Optional[dict]) -> Optional[int]:
 
 class DriverObs:
     """Run-scoped driver session: the ``attempt_end`` / ``run_end``
-    reconciliation stream plus the supervisor heartbeat export."""
+    reconciliation stream plus the supervisor heartbeat export — and,
+    with tracing on, the span skeleton the worker spans hang off: one
+    ``run`` root span and one ``attempt`` span per attempt, whose id
+    is forwarded to the workers as ``OBS_PARENT_SPAN``."""
 
-    def __init__(self, obs_dir: str, run_id: str):
+    def __init__(self, obs_dir: str, run_id: str, trace: bool = True):
         self.obs_dir = obs_dir
         self.run_id = run_id
         self.events = EventLog(events_path(obs_dir, "driver"),
                                run_id=run_id, attempt=0, rank="driver")
+        self.spans: Optional[SpanLog] = None
+        self.run_span_id: Optional[str] = None
+        self.attempt_span_id: Optional[str] = None
+        self._run_t0 = time.time()
+        self._attempt_t0: Optional[float] = None
+        self._run_status: Optional[str] = None
+        if trace:
+            self.spans = SpanLog(spans_path(obs_dir, "driver"),
+                                 run_id=run_id, attempt=0, rank="driver")
+            self.run_span_id = new_span_id()
+
+    def begin_attempt(self, attempt: int) -> Optional[str]:
+        """Mint (and remember) the span id for the attempt ABOUT TO
+        run — the trainer stamps it into every worker's env before the
+        workers launch; the span itself lands at ``note_attempt``."""
+        if self.spans is None:
+            return None
+        self.attempt_span_id = new_span_id()
+        self._attempt_t0 = time.time()
+        self.spans.attempt = int(attempt)
+        return self.attempt_span_id
 
     def note_attempt(self, attempt: int, entry: Dict[str, Any],
                      plan_fingerprint: Optional[str] = None) -> None:
@@ -330,8 +434,18 @@ class DriverObs:
             error=entry.get("error"),
             resumed_step=entry.get("resumed_step"),
             ckpt_save_s=entry.get("ckpt_save_s"))
+        if self.spans is not None and self.attempt_span_id is not None:
+            now = time.time()
+            t0 = self._attempt_t0 if self._attempt_t0 is not None else now
+            self.spans.emit("attempt", now - t0, t1=now,
+                            span_id=self.attempt_span_id,
+                            parent_id=self.run_span_id,
+                            status=entry.get("status"))
+            self.attempt_span_id = None
+            self._attempt_t0 = None
 
     def note_run_end(self, result) -> None:
+        self._run_status = result.status
         self.events.emit("run_end", status=result.status,
                          attempts=result.attempts,
                          preemptions=result.preemptions,
@@ -367,6 +481,16 @@ class DriverObs:
             logger.warning("supervisor export failed: %s", e)
 
     def close(self) -> None:
+        if self.spans is not None:
+            now = time.time()
+            try:
+                self.spans.attempt = 0
+                self.spans.emit("run", now - self._run_t0, t1=now,
+                                span_id=self.run_span_id,
+                                status=self._run_status)
+            except Exception as e:  # noqa: BLE001 - IO best-effort
+                logger.warning("obs run span dropped: %s", e)
+            self.spans.close()
         self.events.close()
 
 
@@ -387,4 +511,5 @@ def start_driver(config: Optional[dict] = None,
     obs_dir = obs_dir or resolve_obs_dir(None, config)
     if obs_dir is None:
         return None
-    return DriverObs(obs_dir, run_id)
+    return DriverObs(obs_dir, run_id,
+                     trace=_truthy(_knob("TRACE", config, "1")))

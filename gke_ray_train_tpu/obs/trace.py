@@ -1,0 +1,261 @@
+"""Causal span tracing — the Dapper-style layer under the event stream
+(ISSUE 14 tentpole, part 1).
+
+PR 10's events say *that* a boundary happened; spans say how long it
+took and what it was caused by. Every instrumented region — the worker
+attempt and its ledger-timed children (restore / compile /
+fast-forward / step windows / eval / checkpoint saves / the preemption
+grace save), the elastic reshard (both the plan re-formation and the
+resharded restore), and the serve engine's per-request lifecycle
+(enqueue → prefill → decode iterations → retire) — lands as ONE JSON
+line in ``<obs_dir>/spans-r<rank>.jsonl`` (driver: ``spans-rdriver``),
+written when the span ENDS (complete-span records survive the SIGKILL
+that usually follows the interesting ones; an in-flight span simply
+never lands, which is itself a signal).
+
+Identity is W3C-trace-context shaped:
+
+- ``trace_id`` (32 hex) is derived DETERMINISTICALLY from the run id
+  (``sha256(OBS_RUN_ID)``), so every rank of every attempt — including
+  driverless multi-rank sessions that never exchange a parent — agrees
+  on one trace without another env hop.
+- ``span_id`` (16 hex) is random per span; the driver's per-attempt
+  span id rides to workers as ``OBS_PARENT_SPAN`` through the same
+  env-forwarding path as ``OBS_RUN_ID``/``OBS_ATTEMPT``, so the worker
+  attempt spans parent under the driver attempt span and the merged
+  DAG is connected across processes.
+
+The span-name vocabulary is CLOSED like the event vocabulary:
+:data:`SPAN_NAMES` is pinned by the shipped
+``obs/schemas/trace.schema.json`` and enforced AT THE EMIT SITE — an
+unknown name or stray attribute raises instead of silently orphaning
+``obs/critical.py``'s term mapping.
+
+Hot-path contract (the obs/ discipline): spans are emitted at the
+boundaries the ledger already times, from host floats the caller
+already measured — never per step (step windows aggregate at the log
+cadence), never with a device fetch of their own. The loss stream with
+TRACE=1 is asserted BITWISE-identical to obs-off.
+
+Stdlib-only (the report/critical-path side runs with no jax).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+import uuid
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Union
+
+logger = logging.getLogger(__name__)
+
+# correlation fields stamped on EVERY span record, in this order.
+# ``t0``/``t1`` are wall-clock (time.time) endpoints; ``dur_s`` is the
+# authoritative duration — measured by the instrumented site itself
+# (perf_counter spans / the exact float the goodput ledger booked), so
+# ``obs/critical.py`` can reconcile spans against the ledger EXACTLY
+# instead of within wall-clock re-derivation noise.
+SPAN_STAMP = ("trace_id", "span_id", "parent_id", "name", "run_id",
+              "attempt", "rank", "slice", "step", "t0", "t1", "dur_s")
+
+# the closed span-name vocabulary: name -> allowed attribute fields.
+# Pinned by obs/schemas/trace.schema.json + tests (both directions).
+SPAN_NAMES: Dict[str, tuple] = {
+    # run/attempt skeleton (driver writes `run` + one `attempt` per
+    # attempt; every worker writes its own `attempt` span parented
+    # under the driver's via OBS_PARENT_SPAN)
+    "run": ("status",),
+    "attempt": ("status",),
+    # the ledger-timed loop boundaries (train/loop.py); durations are
+    # the EXACT floats the GoodputLedger booked for the same regions
+    "restore": ("resumed_step",),
+    "compile": (),
+    "fast_forward": (),
+    "step_window": ("steps", "data_stall_s"),
+    "eval": (),
+    "ckpt_save": ("forced",),
+    "preempt_save": (),
+    # elastic reshard (rayint/elastic.py plan re-formation + the
+    # ckpt/manager.py resharded restore — the same twin pair the
+    # reshard EVENT merges; `where` tells them apart)
+    "reshard": ("from_devices", "to_devices", "where"),
+    # serve request lifecycle (serve/engine.py): one request span with
+    # three children decomposing "where did my p99 go" — queue wait,
+    # prefill, and the decode-iteration region it shared with the
+    # continuous batch
+    "serve_request": ("rid", "bucket", "prompt_len", "generated",
+                      "finish_reason"),
+    "serve_enqueue": ("rid",),
+    "serve_prefill": ("rid",),
+    "serve_decode": ("rid", "iterations"),
+}
+
+
+class SpanError(ValueError):
+    """A span violated the pinned schema (unknown name / stray attr)."""
+
+
+def validate_span(name: str, attrs: Dict[str, Any]) -> None:
+    """Schema teeth at the emit site (the events.py discipline): the
+    contract critical-path extraction relies on is enforced where the
+    span is born, not discovered at read time."""
+    allowed = SPAN_NAMES.get(name)
+    if allowed is None:
+        raise SpanError(f"unknown span name {name!r}; known: "
+                        f"{sorted(SPAN_NAMES)}")
+    # stamp-named attrs are NOT allowed through: emit writes attrs
+    # after the stamp dict, so a payload named `attempt`/`run_id`
+    # would silently clobber the correlation fields the report groups
+    # on (the explicit emit params — step/span_id/parent_id/t1 — are
+    # the only sanctioned way to set those)
+    stray = sorted(set(attrs) - set(allowed))
+    if stray:
+        raise SpanError(f"span {name!r} does not declare attributes "
+                        f"{stray} (allowed: {sorted(allowed)})")
+
+
+def trace_id_for_run(run_id: str) -> str:
+    """The run's trace id, derived (not minted): every process that
+    knows ``OBS_RUN_ID`` computes the same 32-hex id, so driverless
+    multi-rank sessions still merge to ONE trace."""
+    return hashlib.sha256(
+        ("grt-trace:" + str(run_id)).encode()).hexdigest()[:32]
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class SpanLog:
+    """Append-only JSONL span writer for one (rank, attempt) stream —
+    the spans twin of ``events.EventLog`` (same append/flush-per-record
+    semantics, same correlation stamps)."""
+
+    def __init__(self, path: str, *, run_id: str, attempt: int,
+                 rank: Union[int, str],
+                 slice_index: Optional[int] = None):
+        self.path = path
+        self.run_id = str(run_id)
+        self.trace_id = trace_id_for_run(run_id)
+        self.attempt = int(attempt)
+        self.rank = rank
+        self.slice_index = slice_index
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, name: str, dur_s: float, *,
+             t1: Optional[float] = None,
+             span_id: Optional[str] = None,
+             parent_id: Optional[str] = None,
+             step: Optional[int] = None,
+             **attrs: Any) -> Dict[str, Any]:
+        """Record one FINISHED span. ``dur_s`` is the caller's own
+        measurement (authoritative); ``t1`` anchors it on the wall
+        clock (default: now) and ``t0`` is derived — callers never
+        have to carry two clocks."""
+        validate_span(name, attrs)
+        t1 = time.time() if t1 is None else float(t1)
+        dur = max(float(dur_s), 0.0)
+        rec: Dict[str, Any] = {
+            "trace_id": self.trace_id,
+            "span_id": span_id or new_span_id(),
+            "parent_id": parent_id,
+            "name": name,
+            "run_id": self.run_id,
+            "attempt": self.attempt,
+            "rank": self.rank,
+            "slice": self.slice_index,
+            "step": None if step is None else int(step),
+            "t0": round(t1 - dur, 6),
+            "t1": round(t1, 6),
+            "dur_s": dur,
+        }
+        for k, v in attrs.items():
+            if v is None or isinstance(v, (bool, int, float, str)):
+                rec[k] = v
+            else:
+                rec[k] = repr(v)[:200]
+        if self._f is not None and not self._f.closed:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+        return rec
+
+    def close(self) -> None:
+        try:
+            if self._f is not None and not self._f.closed:
+                self._f.close()
+        except OSError:  # pragma: no cover - close is best-effort
+            pass
+
+
+def spans_path(obs_dir: str, rank: Union[int, str]) -> str:
+    return os.path.join(obs_dir, f"spans-r{rank}.jsonl")
+
+
+def iter_spans(obs_dir: str,
+               names: Optional[Iterable[str]] = None
+               ) -> Iterator[Dict[str, Any]]:
+    """Every span record under ``obs_dir`` (all ranks + driver), sorted
+    by start time. Corrupt lines are skipped with a warning, never
+    fatal (the ``iter_events`` contract)."""
+    want = set(names) if names is not None else None
+    out: List[Dict[str, Any]] = []
+    try:
+        entries = sorted(os.listdir(obs_dir))
+    except OSError:
+        return iter(())
+    for fname in entries:
+        if not (fname.startswith("spans-") and fname.endswith(".jsonl")):
+            continue
+        path = os.path.join(obs_dir, fname)
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    logger.warning("%s:%d: skipping corrupt span line",
+                                   path, i + 1)
+                    continue
+                if want is None or rec.get("name") in want:
+                    out.append(rec)
+    out.sort(key=lambda r: (r.get("t0", 0.0), str(r.get("rank"))))
+    return iter(out)
+
+
+def schema_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "schemas", "trace.schema.json")
+
+
+def load_schema() -> Dict[str, Any]:
+    with open(schema_path(), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def check_schema() -> List[str]:
+    """Shipped schema file <-> code contract, both directions (the
+    events.check_schema shape the CI lint step and tests call)."""
+    findings: List[str] = []
+    try:
+        doc = load_schema()
+    except (OSError, ValueError) as e:
+        return [f"trace schema unreadable: {type(e).__name__}: {e}"]
+    if tuple(doc.get("stamp", ())) != SPAN_STAMP:
+        findings.append(f"schema stamp {doc.get('stamp')} != code "
+                        f"SPAN_STAMP {list(SPAN_STAMP)}")
+    names = doc.get("names", {})
+    if set(names) != set(SPAN_NAMES):
+        findings.append(
+            f"schema names {sorted(set(names) ^ set(SPAN_NAMES))} "
+            "drifted from code SPAN_NAMES")
+    for k in set(names) & set(SPAN_NAMES):
+        if tuple(names[k]) != tuple(SPAN_NAMES[k]):
+            findings.append(f"schema name {k!r} attrs {names[k]} != "
+                            f"code {list(SPAN_NAMES[k])}")
+    return findings

@@ -1,7 +1,7 @@
 """Compile-once performance layer (VERDICT r5: hardware-independent
 compile-level guarantees).
 
-Three modules, one goal — compilation is a one-time cost and per-step
+Four modules, one goal — compilation is a one-time cost and per-step
 cost/memory/collective footprints are asserted quantities:
 
 - :mod:`cache` — JAX persistent compilation cache on shared storage
@@ -16,17 +16,37 @@ cost/memory/collective footprints are asserted quantities:
   with tolerances; a budget miss (remat silently off, an extra
   all-reduce in the grad path, peak-memory growth) fails tier-1 tests
   and prints the offending HLO delta.
+- :mod:`compare` — the stdlib-only comparator core budget.py binds its
+  defaults to; ``obs/diff.py`` (the cross-run telemetry regression
+  gate) reuses it on machines with no jax.
+
+The package re-exports are LAZY (PEP 562): ``perf.cache``/``perf.costs``
+import jax at module level, but ``perf.compare`` must stay importable
+from the jax-free obs CLI path — materializing this ``__init__`` must
+not drag the backend in. (``perf.budget`` additionally stays un-imported
+here because it doubles as a ``python -m`` CLI and runpy warns when the
+target was already materialized by its package init.)
 """
 
-from gke_ray_train_tpu.perf.cache import (  # noqa: F401
-    aot_signature, build_or_load_step, cache_stats, enable_persistent_cache,
-    load_executable, log_cache_summary, save_executable,
-    topology_fingerprint)
-from gke_ray_train_tpu.perf.costs import (  # noqa: F401
-    ChipSpec, StepCostReport, chip_spec_for_devices, collective_stats,
-    step_cost_report)
+_LAZY_EXPORTS = {
+    # cache
+    "aot_signature": "cache", "build_or_load_step": "cache",
+    "cache_stats": "cache", "enable_persistent_cache": "cache",
+    "load_executable": "cache", "log_cache_summary": "cache",
+    "save_executable": "cache", "topology_fingerprint": "cache",
+    # costs
+    "ChipSpec": "costs", "StepCostReport": "costs",
+    "chip_spec_for_devices": "costs", "collective_stats": "costs",
+    "step_cost_report": "costs",
+}
 
-# perf.budget is NOT imported eagerly: it doubles as the re-baseline CLI
-# (`python -m gke_ray_train_tpu.perf.budget`), and runpy warns when the
-# target module was already materialized by its package __init__
+__all__ = sorted(_LAZY_EXPORTS)
 
+
+def __getattr__(name):
+    mod = _LAZY_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

@@ -30,6 +30,7 @@ import subprocess
 import sys
 from typing import Any, Dict, List, Optional, Union
 
+from gke_ray_train_tpu.perf.compare import compare_dicts, hlo_delta
 from gke_ray_train_tpu.perf.costs import (
     COLLECTIVE_KINDS, StepCostReport, step_cost_report)
 
@@ -100,96 +101,23 @@ def write_budget(report: Union[StepCostReport, Dict[str, Any]], path: str,
     return doc
 
 
-def _rel_diff(a: float, b: float) -> float:
-    if b == 0:
-        return 0.0 if a == 0 else float("inf")
-    return abs(a - b) / abs(b)
-
-
 def compare_to_budget(report: Union[StepCostReport, Dict[str, Any]],
                       budget: Dict[str, Any],
                       tolerances: Optional[Dict[str, float]] = None
                       ) -> List[str]:
-    """Violation strings (empty = within budget). Scalar fields use
-    two-sided relative tolerances; collective counts are exact, and a
-    count mismatch carries the HLO-line delta so the offending op is
-    named, not just counted."""
+    """Violation strings (empty = within budget) — the stdlib-only
+    comparator core (``perf/compare.py``; ``obs diff`` reuses it over
+    telemetry reports) bound to this module's cost-report defaults:
+    :data:`DEFAULT_TOLERANCES` and exact per-kind collective counts."""
     if isinstance(report, StepCostReport):
         report = report.to_dict()
-    tol = dict(DEFAULT_TOLERANCES)
-    tol.update(budget.get("tolerances", {}))
-    tol.update(tolerances or {})
-    viols: List[str] = []
-    overlap_tripped = False
-    dcn_tripped = False
-    for field, t in tol.items():
-        if field not in budget or field not in report:
-            continue
-        have, want = float(report[field]), float(budget[field])
-        d = _rel_diff(have, want)
-        if d > t:
-            viols.append(
-                f"{field}: {have:.4g} vs budget {want:.4g} "
-                f"({'+' if have > want else '-'}{d:.1%}, tolerance "
-                f"{t:.0%})")
-            if field in ("exposed_collective_bytes", "overlap_frac"):
-                overlap_tripped = True
-            if field == "dcn_bytes":
-                dcn_tripped = True
-    if overlap_tripped:
-        # the offending schedule region: which collectives changed
-        # exposure state (hidden <-> EXPOSED) or appeared/vanished
-        viols.extend(_hlo_delta(report.get("exposure_lines", []),
-                                budget.get("exposure_lines", [])))
-    if dcn_tripped:
-        # which collectives changed their slice-crossing byte load —
-        # the reshard-fattened-the-DCN-hop signal, named per op
-        viols.extend(_hlo_delta(report.get("dcn_lines", []),
-                                budget.get("dcn_lines", [])))
-
-    want_counts = budget.get("collective_counts")
-    if want_counts is not None:
-        have_counts = report.get("collective_counts", {})
-        mismatched = [
-            k for k in COLLECTIVE_KINDS
-            if int(have_counts.get(k, 0)) != int(want_counts.get(k, 0))]
-        if mismatched:
-            detail = ", ".join(
-                f"{k}: {have_counts.get(k, 0)} vs budget "
-                f"{want_counts.get(k, 0)}" for k in mismatched)
-            viols.append(f"collective counts changed ({detail})")
-            viols.extend(_hlo_delta(report.get("collective_lines", []),
-                                    budget.get("collective_lines", [])))
-    return viols
+    return compare_dicts(report, budget, tolerances,
+                         default_tolerances=DEFAULT_TOLERANCES,
+                         collective_kinds=COLLECTIVE_KINDS)
 
 
-def _hlo_delta(have_lines: List[str], want_lines: List[str],
-               cap: int = 8) -> List[str]:
-    """The offending HLO delta: collective lines present on one side
-    only (multiset diff, op names normalized away so textual id drift
-    between compiles does not flood the report)."""
-    import re
-
-    def norm(line):
-        return re.sub(r"%[\w.\-]+", "%_", line)
-
-    have = [norm(x) for x in have_lines]
-    want = [norm(x) for x in want_lines]
-    out: List[str] = []
-    added = list(have)
-    for w in want:
-        if w in added:
-            added.remove(w)
-    removed = list(want)
-    for h in have:
-        if h in removed:
-            removed.remove(h)
-    for tag, lines in (("+", added), ("-", removed)):
-        for ln in lines[:cap]:
-            out.append(f"  HLO {tag} {ln}")
-        if len(lines) > cap:
-            out.append(f"  HLO {tag} ... {len(lines) - cap} more")
-    return out
+# jaxprcheck (and older call sites) import the delta printer from here
+_hlo_delta = hlo_delta
 
 
 def assert_within_budget(report: Union[StepCostReport, Dict[str, Any]],

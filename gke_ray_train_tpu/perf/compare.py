@@ -1,0 +1,110 @@
+"""The two-sided budget comparator core — stdlib-only (ISSUE 14).
+
+Extracted from ``perf/budget.py`` (which re-exports it; one
+implementation, zero forks) so consumers that must run without jax can
+reuse it: ``perf/budget.py`` pulls in ``perf/costs.py`` → jax at
+import, but the comparison itself is pure float/dict work.
+``obs/diff.py`` — the cross-run regression gate over telemetry reports
+— is exactly such a consumer: runtime goodput/latency numbers are
+gated by the SAME comparator shape (two-sided relative tolerances,
+per-field overrides recorded in the checked-in JSON, offending-term
+delta printed on a trip) that already gates HLO cost numbers.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Iterable, List, Optional
+
+
+def rel_diff(a: float, b: float) -> float:
+    if b == 0:
+        return 0.0 if a == 0 else float("inf")
+    return abs(a - b) / abs(b)
+
+
+def hlo_delta(have_lines: List[str], want_lines: List[str],
+              cap: int = 8) -> List[str]:
+    """The offending HLO delta: collective lines present on one side
+    only (multiset diff, op names normalized away so textual id drift
+    between compiles does not flood the report)."""
+    def norm(line):
+        return re.sub(r"%[\w.\-]+", "%_", line)
+
+    have = [norm(x) for x in have_lines]
+    want = [norm(x) for x in want_lines]
+    out: List[str] = []
+    added = list(have)
+    for w in want:
+        if w in added:
+            added.remove(w)
+    removed = list(want)
+    for h in have:
+        if h in removed:
+            removed.remove(h)
+    for tag, lines in (("+", added), ("-", removed)):
+        for ln in lines[:cap]:
+            out.append(f"  HLO {tag} {ln}")
+        if len(lines) > cap:
+            out.append(f"  HLO {tag} ... {len(lines) - cap} more")
+    return out
+
+
+def compare_dicts(report: Dict[str, Any], budget: Dict[str, Any],
+                  tolerances: Optional[Dict[str, float]] = None, *,
+                  default_tolerances: Optional[Dict[str, float]] = None,
+                  collective_kinds: Optional[Iterable[str]] = None
+                  ) -> List[str]:
+    """Violation strings (empty = within budget). Scalar fields use
+    two-sided relative tolerances (``default_tolerances`` overlaid by
+    the budget's own ``tolerances`` key overlaid by the argument);
+    collective counts — when both sides carry them — are exact, and a
+    count mismatch carries the HLO-line delta so the offending op is
+    named, not just counted."""
+    tol = dict(default_tolerances or {})
+    tol.update(budget.get("tolerances", {}))
+    tol.update(tolerances or {})
+    viols: List[str] = []
+    overlap_tripped = False
+    dcn_tripped = False
+    for field, t in tol.items():
+        if field not in budget or field not in report:
+            continue
+        have, want = float(report[field]), float(budget[field])
+        d = rel_diff(have, want)
+        if d > t:
+            viols.append(
+                f"{field}: {have:.4g} vs budget {want:.4g} "
+                f"({'+' if have > want else '-'}{d:.1%}, tolerance "
+                f"{t:.0%})")
+            if field in ("exposed_collective_bytes", "overlap_frac"):
+                overlap_tripped = True
+            if field == "dcn_bytes":
+                dcn_tripped = True
+    if overlap_tripped:
+        # the offending schedule region: which collectives changed
+        # exposure state (hidden <-> EXPOSED) or appeared/vanished
+        viols.extend(hlo_delta(report.get("exposure_lines", []),
+                               budget.get("exposure_lines", [])))
+    if dcn_tripped:
+        # which collectives changed their slice-crossing byte load —
+        # the reshard-fattened-the-DCN-hop signal, named per op
+        viols.extend(hlo_delta(report.get("dcn_lines", []),
+                               budget.get("dcn_lines", [])))
+
+    want_counts = budget.get("collective_counts")
+    if want_counts is not None:
+        have_counts = report.get("collective_counts", {})
+        kinds = (list(collective_kinds) if collective_kinds is not None
+                 else sorted(set(have_counts) | set(want_counts)))
+        mismatched = [
+            k for k in kinds
+            if int(have_counts.get(k, 0)) != int(want_counts.get(k, 0))]
+        if mismatched:
+            detail = ", ".join(
+                f"{k}: {have_counts.get(k, 0)} vs budget "
+                f"{want_counts.get(k, 0)}" for k in mismatched)
+            viols.append(f"collective counts changed ({detail})")
+            viols.extend(hlo_delta(report.get("collective_lines", []),
+                                   budget.get("collective_lines", [])))
+    return viols

@@ -204,6 +204,12 @@ class ExecutionPlan:
     # fires at most once per attempt, bounded by the capture budget
     obs_capture: bool = True
     obs_capture_budget: int = 4
+    # causal span tracing (obs/trace.py): per-rank spans-r<N>.jsonl
+    # streams under the obs dir — the attempt's ledger-timed boundaries
+    # plus serve request lifecycles, merged by `obs report` into a
+    # per-attempt critical path. Rides the obs session (OBS=0 disables
+    # both); operational like every obs knob — never compile-relevant.
+    trace: bool = True
 
     # -- overlap / fused-kernel execution path (ROADMAP #3) -------------
     # communication/compute overlap mode for the train step:
@@ -693,6 +699,7 @@ CONFIG_KEYS: Dict[str, str] = {
     "obs_dir": "OBS_DIR",
     "obs_capture": "OBS_CAPTURE",
     "obs_capture_budget": "OBS_CAPTURE_BUDGET",
+    "trace": "TRACE",
     "overlap": "OVERLAP",
     "fused_ops": "FUSED_OPS",
     "dcn_sync": "DCN_SYNC",
@@ -843,7 +850,7 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
         "prefetch",
         # obs telemetry knobs ride to the workers the same way (a
         # driver-side `env OBS_DIR=...` must shape every rank's stream)
-        "obs", "obs_dir", "obs_capture", "obs_capture_budget",
+        "obs", "obs_dir", "obs_capture", "obs_capture_budget", "trace",
         # a driver-side `env OVERLAP=manual` / `FUSED_OPS=1` A/B must
         # shape the program every worker compiles — and so must the
         # DCN gradient-sync arms (`env DCN_SYNC=hier DCN_COMPRESS=bf16`)
@@ -852,7 +859,7 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
                           "divergence_guard", "obs", "obs_capture",
-                          "fused_ops"})
+                          "trace", "fused_ops"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",

@@ -102,8 +102,12 @@ def maybe_replan(plan, devices=None, *, config=None, model_cfg=None,
     if not os.environ.get(POOL_ENV) or len(devs) == plan.chips \
             or not elastic_enabled(config):
         return plan, devs
+    import time
+
     from gke_ray_train_tpu.plan import replan
+    t_replan0 = time.perf_counter()
     new_plan = replan(plan, len(devs), model_cfg=model_cfg)
+    replan_dt = time.perf_counter() - t_replan0
     (log or logger).warning(
         "elastic re-formation: pool %d -> %d devices; plan %s -> %s "
         "(mesh %s, per_device_batch %d, topology %s)",
@@ -119,4 +123,9 @@ def maybe_replan(plan, devices=None, *, config=None, model_cfg=None,
         to_fingerprint=new_plan.fingerprint(),
         mesh={a: getattr(new_plan, a) for a in new_plan.axis_names()},
         per_device_batch=new_plan.per_device_batch)
+    # ...and a causal span (obs/trace.py): the plan-level half of the
+    # reshard twin pair (ckpt/manager.py spans the resharded restore)
+    obs_runtime.span_add(
+        "reshard", replan_dt, from_devices=plan.chips,
+        to_devices=len(devs), where="replan")
     return new_plan, devs

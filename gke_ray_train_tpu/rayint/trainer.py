@@ -388,6 +388,17 @@ class JaxTrainer:
             # action is os.environ.update(env) — one write site
             env["OBS_RUN_ID"] = self._obs.run_id
             env["OBS_ATTEMPT"] = str(self._attempt or 1)
+            if self._obs.attempt_span_id is not None:
+                # trace context (obs/trace.py): the driver's attempt
+                # span is the causal parent of every worker attempt
+                # span this attempt spawns
+                from gke_ray_train_tpu.obs.runtime import PARENT_SPAN_ENV
+                env[PARENT_SPAN_ENV] = self._obs.attempt_span_id
+        if self._obs is None or self._obs.attempt_span_id is None:
+            # local path shares os.environ across fits — a stale parent
+            # from a previous traced fit must not adopt this attempt
+            from gke_ray_train_tpu.obs.runtime import PARENT_SPAN_ENV
+            os.environ.pop(PARENT_SPAN_ENV, None)
         # a RunConfig(elastic=True) opt-in must reach the worker-side
         # gate too (rayint/elastic.py reads config/env only) — else the
         # driver arms the override and the workers refuse to replan
@@ -801,6 +812,11 @@ class JaxTrainer:
         while True:
             attempt += 1
             self._attempt = attempt       # stamped into worker env
+            if self._obs is not None:
+                # mint the attempt span id BEFORE the workers launch —
+                # _pool_env forwards it as their causal parent; the
+                # span itself lands at note_attempt with the verdict
+                self._obs.begin_attempt(attempt)
             t_attempt = time.perf_counter()
             try:
                 result, out = self._fit_ray() if self.use_ray \

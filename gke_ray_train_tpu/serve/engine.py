@@ -193,6 +193,12 @@ class _Slot:
     prompt_len: int
     submit_t: float
     first_token_t: float
+    # span bookkeeping (obs/trace.py): host perf_counter stamps the
+    # admit path already took — the request's lifecycle spans are
+    # emitted retroactively at retirement from these, so tracing adds
+    # zero work to the decode loop
+    prefill_t0: float = 0.0
+    decodes0: int = 0
 
 
 class _BucketRuntime:
@@ -437,6 +443,7 @@ class BatchEngine:
                 self.refills += 1
             buf, plen = form_prompt_buffer(req.token_ids, width)
             stop = min(plen + req.max_new_tokens, width)
+            t_prefill0 = time.perf_counter()
             first, cache_row = self._get("prefill", width)(
                 self.params, jnp.asarray(buf),
                 jnp.asarray([plen], jnp.int32), self.lora)
@@ -450,7 +457,9 @@ class BatchEngine:
                 jnp.asarray([stop], jnp.int32), first)
             now = time.perf_counter()
             rt.slots[slot] = _Slot(req.rid, plen,
-                                   self._submit_t[req.rid], now)
+                                   self._submit_t[req.rid], now,
+                                   prefill_t0=t_prefill0,
+                                   decodes0=rt.decodes)
             rt.host_active[slot] = True
         self._pending = still_pending
 
@@ -476,6 +485,7 @@ class BatchEngine:
                 submit_s=slot.submit_t,
                 first_token_s=slot.first_token_t - slot.submit_t,
                 done_s=now - slot.submit_t)
+            self._trace_request(rt, slot, now, length, reason)
             rt.slots[i] = None
             rt.host_active[i] = False
             self.completed_total += 1
@@ -483,6 +493,48 @@ class BatchEngine:
             # long-lived replica must not grow per served request
             self._submit_t.pop(slot.rid, None)
             self._pending_bucket.pop(slot.rid, None)
+
+    def _trace_request(self, rt: _BucketRuntime, slot: _Slot,
+                       now: float, length: int, reason: str) -> None:
+        """Emit the request's lifecycle spans (obs/trace.py) at
+        retirement — the "where did my p99 go" decomposition: enqueue
+        (submit → prefill dispatch), prefill (dispatch → first token
+        materialized), decode (admission → retire, with the iteration
+        count it shared with the continuous batch). Everything here is
+        host floats the engine already stamped; emission is once per
+        COMPLETED request, never per decode iteration, so the one-
+        ``device_get``-per-iteration hot-path contract holds. No-op
+        when obs/tracing is off."""
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        if not obs_runtime.tracing():
+            return
+        anchor = time.time()      # map perf_counter diffs to wall ts
+
+        def t1_of(pc: float) -> float:
+            return anchor - (now - pc)
+
+        req_id = obs_runtime.span_add(
+            "serve_request", now - slot.submit_t, t1=anchor,
+            rid=slot.rid, bucket=rt.width, prompt_len=slot.prompt_len,
+            generated=int(length - slot.prompt_len),
+            finish_reason=reason)
+        if req_id is None:
+            # the parent write failed (IO): children with parent=None
+            # would re-parent under the attempt span and read as
+            # attempt-level path leaves — a lossy trace must stay
+            # consistent, so drop the orphans with their parent
+            return
+        obs_runtime.span_add(
+            "serve_enqueue", slot.prefill_t0 - slot.submit_t,
+            t1=t1_of(slot.prefill_t0), parent_id=req_id, rid=slot.rid)
+        obs_runtime.span_add(
+            "serve_prefill", slot.first_token_t - slot.prefill_t0,
+            t1=t1_of(slot.first_token_t), parent_id=req_id,
+            rid=slot.rid)
+        obs_runtime.span_add(
+            "serve_decode", now - slot.first_token_t, t1=anchor,
+            parent_id=req_id, rid=slot.rid,
+            iterations=int(rt.decodes - slot.decodes0))
 
     def step(self) -> int:
         """One engine iteration: admit into free slots, then run ONE

@@ -147,6 +147,19 @@ def run_training(state: TrainState,
         obs.capture._conflict = lambda: bool(
             getattr(profiler, "active", False))
     _obs_prev = [t_loop0, 0.0]   # [last note time, last eval/ckpt total]
+    # step-window span accumulator (obs/trace.py): [step_s,
+    # data_stall_s, steps] since the last flush. Spans aggregate at the
+    # log cadence (plus one tail flush at loop exit), never per step —
+    # the same hot-path contract the event stream keeps. data_stall_s
+    # accumulates the identical wait floats the ledger books, so the
+    # span-derived stall total reconciles with the ledger exactly.
+    _win = [0.0, 0.0, 0]
+
+    def _flush_window(step):
+        if obs is not None and _win[2]:
+            obs.span_add("step_window", _win[0] + _win[1], step=step,
+                         steps=_win[2], data_stall_s=_win[1])
+        _win[:] = [0.0, 0.0, 0]
     if guards is None:
         guards = RuntimeGuards.from_config()
     # KERNELCHECK=1 (analysis/kernelcheck.py): before anything trains,
@@ -188,10 +201,17 @@ def run_training(state: TrainState,
             full, resumed = ckpt_manager.restore_if_available(state)
             if resumed is not None:
                 state = full
-        ledger.note("restore_s", time.perf_counter() - t_restore0)
+        restore_dt = time.perf_counter() - t_restore0
+        ledger.note("restore_s", restore_dt)
         if resumed is not None and is_host0:
             logger.info("resumed at step %d", resumed)
         resumed_step = resumed
+        if obs is not None:
+            # span duration is the EXACT float the ledger booked — the
+            # critical-path reconciliation (obs/critical.py) depends on
+            # the two streams agreeing bitwise, not approximately
+            obs.span_add("restore", restore_dt, step=resumed,
+                         resumed_step=resumed)
         if obs is not None and resumed is not None:
             obs.emit("resume", step=resumed, resumed_step=resumed)
         # attempt metadata for Result.attempt_log (rayint/trainer.py);
@@ -279,6 +299,8 @@ def run_training(state: TrainState,
         # trainer's elastic re-form
         ledger.close(time.perf_counter() - t_loop0)
         if obs is not None:
+            if save_s is not None:
+                obs.span_add("preempt_save", save_s, step=step)
             obs.emit("preempt_exit", step=step, save_s=save_s,
                      grace_remaining_s=preempt.remaining_grace_s(),
                      pool=preempt.pool_target())
@@ -336,6 +358,13 @@ def run_training(state: TrainState,
                 if meter is not None:
                     meter.data_wait(wait_s)
                 ledger.data_wait(wait_s)
+                # the span-side twin is accumulated HERE, at the same
+                # point the ledger books — a crash later in the
+                # iteration must leave both streams agreeing, or the
+                # report's span/ledger reconciliation (rc=3) fires on
+                # a healthy trace over a non-telemetry failure
+                if obs is not None:
+                    _win[1] += max(float(wait_s), 0.0)
             trained_this_epoch += 1
             if not loop_timing:
                 # DIVERGENCE_GUARD (multi-host, opt-in): every host
@@ -360,11 +389,19 @@ def run_training(state: TrainState,
                 # fresh start fast-forwarded nothing — its warmup stays
                 # in step_s rather than fabricating resume time.
                 ledger.note("compile_s", loop_timing["compile_s"])
+                if obs is not None:
+                    obs.span_add("compile", loop_timing["compile_s"],
+                                 step=global_step + 1)
                 if resumed_step is not None:
-                    ledger.note(
-                        "fast_forward_s",
-                        loop_timing["restart_to_first_step_s"]
-                        - loop_timing["compile_s"] - ledger.restore_s)
+                    ff_dt = (loop_timing["restart_to_first_step_s"]
+                             - loop_timing["compile_s"]
+                             - ledger.restore_s)
+                    ledger.note("fast_forward_s", ff_dt)
+                    if obs is not None:
+                        # ledger.note clamps negatives to 0; mirror it
+                        # so span and ledger stay bitwise-equal
+                        obs.span_add("fast_forward", max(ff_dt, 0.0),
+                                     step=global_step + 1)
                 if obs is not None:
                     obs.emit("first_step", step=global_step + 1,
                              compile_s=loop_timing["compile_s"],
@@ -392,13 +429,15 @@ def run_training(state: TrainState,
                 _now = time.perf_counter()
                 _booked = (ledger.eval_ckpt_stall_s + ledger.compile_s
                            + ledger.restore_s + ledger.fast_forward_s)
-                obs.note_step(
-                    global_step,
-                    max(_now - _obs_prev[0] - wait_s
-                        - (_booked - _obs_prev[1]), 0.0),
-                    wait_s)
+                _iter_v = max(_now - _obs_prev[0] - wait_s
+                              - (_booked - _obs_prev[1]), 0.0)
+                obs.note_step(global_step, _iter_v, wait_s)
                 _obs_prev[0] = _now
                 _obs_prev[1] = _booked
+                # step-window span feed (the stall half was booked at
+                # the ledger's own site above)
+                _win[0] += _iter_v
+                _win[2] += 1
             if meter is not None:
                 # tokens metric is device-resident; fetching it each step
                 # would sync — use the (static) batch token count instead
@@ -417,6 +456,7 @@ def run_training(state: TrainState,
                     # fetched above — obs adds no device traffic
                     obs.log_metrics(global_step, last_metrics,
                                     epoch=epoch)
+                    _flush_window(global_step)
                 if is_host0:
                     logger.info(
                         "epoch %d step %d loss %.4f lr %.3g%s",
@@ -435,8 +475,22 @@ def run_training(state: TrainState,
                 # compute is booked as training, not stall
                 if meter is not None:
                     jax.block_until_ready(m)
-                with paused(meter), paused(ledger), allow_transfers():
-                    eval_metrics = eval_fn(state)
+                _ev0 = ledger.eval_ckpt_stall_s
+                try:
+                    with paused(meter), paused(ledger), \
+                            allow_transfers():
+                        eval_metrics = eval_fn(state)
+                finally:
+                    # span duration = exactly what the ledger booked
+                    # for this pause (the delta, not a re-measurement)
+                    # — emitted on the exception path too, because
+                    # paused() books on __exit__ regardless and the
+                    # two streams must agree for the crashed attempt's
+                    # report to reconcile
+                    if obs is not None:
+                        obs.span_add("eval",
+                                     ledger.eval_ckpt_stall_s - _ev0,
+                                     step=global_step)
                 last_metrics.update(eval_metrics)
                 if tb_writer is not None:
                     tb_writer.log(global_step, eval_metrics)
@@ -451,9 +505,17 @@ def run_training(state: TrainState,
                     global_step % ckpt_every == 0:
                 m_host = _fetch_metrics(m)
                 t_save0 = time.perf_counter()
-                with paused(meter), paused(ledger), allow_transfers():
-                    ckpt_manager.save(global_step, save_view(state),
-                                      metrics=m_host)
+                _ck0 = ledger.eval_ckpt_stall_s
+                try:
+                    with paused(meter), paused(ledger), \
+                            allow_transfers():
+                        ckpt_manager.save(global_step, save_view(state),
+                                          metrics=m_host)
+                finally:
+                    if obs is not None:
+                        obs.span_add("ckpt_save",
+                                     ledger.eval_ckpt_stall_s - _ck0,
+                                     step=global_step, forced=False)
                 if obs is not None:
                     obs.emit("ckpt_save", step=global_step,
                              save_s=time.perf_counter() - t_save0,
@@ -492,8 +554,15 @@ def run_training(state: TrainState,
         if meter is not None:
             epoch_metrics.update(meter.snapshot())
         if eval_fn is not None and eval_at_epoch_end:
-            with paused(ledger), allow_transfers():
-                epoch_metrics.update(eval_fn(state))
+            _ev0 = ledger.eval_ckpt_stall_s
+            try:
+                with paused(ledger), allow_transfers():
+                    epoch_metrics.update(eval_fn(state))
+            finally:
+                if obs is not None:
+                    obs.span_add("eval",
+                                 ledger.eval_ckpt_stall_s - _ev0,
+                                 step=global_step)
         if tb_writer is not None:
             tb_writer.log(global_step, epoch_metrics)
             tb_writer.flush()
@@ -501,9 +570,16 @@ def run_training(state: TrainState,
             obs.emit("epoch_end", step=global_step, epoch=epoch)
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
-            with paused(ledger), allow_transfers():
-                ckpt_manager.save(global_step, save_view(state),
-                                  metrics=m_host)
+            _ck0 = ledger.eval_ckpt_stall_s
+            try:
+                with paused(ledger), allow_transfers():
+                    ckpt_manager.save(global_step, save_view(state),
+                                      metrics=m_host)
+            finally:
+                if obs is not None:
+                    obs.span_add("ckpt_save",
+                                 ledger.eval_ckpt_stall_s - _ck0,
+                                 step=global_step, forced=False)
         if report_fn is not None:
             report_fn(epoch_metrics)
     finally:
@@ -514,6 +590,10 @@ def run_training(state: TrainState,
         from gke_ray_train_tpu.rayint.context import get_context
         get_context().note_goodput(ledger.as_dict())
         if obs is not None:
+            # tail step-window span: the steps since the last log
+            # boundary must not fall off the trace — critical-path
+            # coverage is checked against the ledger
+            _flush_window(global_step)
             # ledger terms -> the obs registry, and the registry -> TB
             # (train/tb.py log_registry): the dashboard, the Prometheus
             # textfile and `obs report` all read the SAME decomposition

@@ -76,10 +76,22 @@ run elastic        env BENCH_MODE=elastic OBS_DIR="$OBS_ELASTIC_DIR" python benc
 
 # ...which `obs report` (gke_ray_train_tpu/obs) merges into ONE
 # reconciled per-run artifact: per-attempt timeline (both reshards),
-# goodput ledger terms summing to attempt wall-clock exactly, anomaly/
-# capture inventory, and the bench record — report.json stays beside
-# the events, the summary line lands in $OUT
+# goodput ledger terms summing to attempt wall-clock exactly, the
+# causal trace's per-attempt critical path (span/ledger reconciled,
+# rc=3 on drift), anomaly/capture inventory, and the bench record —
+# report.json stays beside the events, the summary line lands in $OUT
 run obs-report     python -m gke_ray_train_tpu.obs report "$OBS_ELASTIC_DIR"
+
+# the elastic drill's post-run self-check: `obs diff` compares the
+# fresh report against the checked-in regression ledger
+# (tests/regressions/elastic_cpu8.json) under two-sided tolerances —
+# goodput composition, counts, serve latency, critical-path shares —
+# and the verdict is its own artifact line (rc=4 prints the offending
+# term delta). After an INTENTIONAL goodput change, re-record with
+# REGRESSION_UPDATE=1 (or `obs diff ... --update`) and review the JSON
+# diff like code.
+run obs-diff       python -m gke_ray_train_tpu.obs diff "$OBS_ELASTIC_DIR" \
+    tests/regressions/elastic_cpu8.json
 
 # compile-cost budgets (tests/budgets/*.json) are recorded on the
 # canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI

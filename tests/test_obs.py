@@ -37,6 +37,7 @@ def _clean_session(monkeypatch):
     monkeypatch.delenv("OBS_RUN_ID", raising=False)
     monkeypatch.delenv("OBS_ATTEMPT", raising=False)
     monkeypatch.delenv("OBS_DIR", raising=False)
+    monkeypatch.delenv("OBS_PARENT_SPAN", raising=False)
     yield
     obs_runtime.end_attempt("test-cleanup")
 
@@ -149,8 +150,9 @@ def _batches(steps, B=2, S=16, vocab=128, hook=None):
 
 
 def test_obs_off_hot_path_bitwise(tmp_path):
-    """The acceptance gate: the loss stream with obs fully enabled is
-    BITWISE-identical to obs off — telemetry adds no device traffic
+    """The acceptance gate: the loss stream with obs fully enabled —
+    including causal span tracing, which defaults on (TRACE=1) — is
+    BITWISE-identical to obs off: telemetry adds no device traffic
     and perturbs no numerics."""
     from gke_ray_train_tpu.train.loop import run_training
 
@@ -172,10 +174,15 @@ def test_obs_off_hot_path_bitwise(tmp_path):
     flat_off = jax.tree_util.tree_leaves(params_off)
     flat_on = jax.tree_util.tree_leaves(params_on)
     assert all(np.array_equal(a, b) for a, b in zip(flat_on, flat_off))
-    # and the enabled run actually produced telemetry
+    # and the enabled run actually produced telemetry — events AND
+    # spans (tracing was on, so the bitwise claim covers TRACE=1)
     evs = [json.loads(line) for line in
            open(tmp_path / "obs_on" / "events-r0.jsonl")]
     assert {"step", "worker_exit"} <= {e["kind"] for e in evs}
+    sps = [json.loads(line) for line in
+           open(tmp_path / "obs_on" / "spans-r0.jsonl")]
+    assert {"compile", "step_window", "attempt"} <= \
+        {s["name"] for s in sps}
 
 
 def test_anomaly_capture_fire_once(tmp_path):
@@ -468,7 +475,22 @@ def _elastic_drill(work):
                 place_batch=make_place_batch(mesh), fault_injector=inj)
         finally:
             mgr.close()
-        return {"final_step": int(jax.device_get(final.step))}
+        # serve ONE request on the trained weights inside the same
+        # attempt (the SERVE_AFTER_TRAIN shape, engine-direct): the
+        # trace must decompose a request end-to-end — enqueue /
+        # prefill / decode — beside the training spans (ISSUE 14)
+        from gke_ray_train_tpu.serve.engine import BatchEngine, Request
+        host_params = jax.device_get(final.params)
+        engine = BatchEngine(
+            host_params, cfg, eos_ids=(),
+            plan=ExecutionPlan.from_kwargs(
+                max_batch=2, decode_buckets="16", aot_train_step=False,
+                compile_cache=False))
+        comps = engine.run_until_drained([Request(
+            rid="drill0", token_ids=np.arange(3, 9, dtype=np.int32),
+            max_new_tokens=4)])
+        return {"final_step": int(jax.device_get(final.step)),
+                "served": len(comps)}
 
     reset_fired()
     reset_pool()
@@ -670,6 +692,144 @@ def test_report_rejects_unreconciled(tmp_path):
                         "report", str(tmp_path)],
                        capture_output=True, text=True, env=env)
     assert r.returncode == 3
+
+
+def test_crashed_attempt_trace_still_reconciles(tmp_path):
+    """Span/ledger coherence on the EXCEPTION path: a step that dies
+    right after the ledger booked a data wait (and an eval that dies
+    inside its paused() region) must not leave the span stream short
+    of the ledger — a crashed run's report is exactly when the
+    critical path matters, and rc=3 there would cry 'telemetry bug'
+    over a training failure."""
+    from gke_ray_train_tpu.obs.report import build_report
+    from gke_ray_train_tpu.train.loop import run_training
+    _, _, state, step = _tiny_setup()
+    calls = {"n": 0}
+
+    def crashing_step(st, batch):
+        calls["n"] += 1
+        if calls["n"] == 4:
+            raise RuntimeError("boom mid-iteration")
+        return step(st, batch)
+
+    def hook(i):
+        if i == 3:                 # the doomed call's batch: its wait
+            time.sleep(0.06)       # is ledger-booked BEFORE the step
+
+    obs_runtime.start_attempt(obs_dir=str(tmp_path / "a"))
+    try:
+        with pytest.raises(RuntimeError, match="boom"):
+            run_training(state, crashing_step, _batches(8, hook=hook),
+                         epochs=1, log_every=2)
+    finally:
+        obs_runtime.end_attempt("failed")
+    rep = build_report(str(tmp_path / "a"))
+    assert rep["critical_path_ok"] is True, \
+        rep["attempts"][0].get("critical_path")
+
+    # and the eval twin: paused(ledger) books on __exit__ even when
+    # eval raises — the span must be emitted on that path too
+    _, _, state2, step2 = _tiny_setup()
+
+    def bad_eval(st):
+        time.sleep(0.03)
+        raise RuntimeError("eval boom")
+
+    obs_runtime.start_attempt(obs_dir=str(tmp_path / "b"))
+    try:
+        with pytest.raises(RuntimeError, match="eval boom"):
+            run_training(state2, step2, _batches(8), epochs=1,
+                         log_every=2, eval_fn=bad_eval, eval_every=3)
+    finally:
+        obs_runtime.end_attempt("failed")
+    rep = build_report(str(tmp_path / "b"))
+    assert rep["critical_path_ok"] is True, \
+        rep["attempts"][0].get("critical_path")
+    spans = [json.loads(line) for line in
+             open(tmp_path / "b" / "spans-r0.jsonl")]
+    assert any(s["name"] == "eval" for s in spans)
+
+
+def test_trace_critical_path_and_diff_on_elastic_drill(tmp_path):
+    """ISSUE 14 acceptance on the existing drill path: the 8->4->8 run
+    produces ONE merged trace whose per-attempt critical path
+    reconciles exactly with the goodput ledger (CLI rc=0), shows both
+    reshard spans, and decomposes a serve request end-to-end; `obs
+    diff` passes self-vs-self and trips with a named term delta on a
+    doctored goodput_frac."""
+    from gke_ray_train_tpu.obs import trace as obs_trace
+    from gke_ray_train_tpu.obs.diff import diff_flat, flatten_report
+    from gke_ray_train_tpu.obs.report import build_report
+    obs_dir, res = _elastic_drill(str(tmp_path))
+    assert res.metrics.get("served") == 1
+
+    spans = list(obs_trace.iter_spans(obs_dir))
+    assert spans, "the traced drill must leave a span stream"
+    # ONE merged trace: every span of every rank + the driver agrees
+    assert len({s["trace_id"] for s in spans}) == 1
+    # worker attempt spans parent under the driver's attempt spans
+    drv = {s["span_id"]: s for s in spans
+           if s["rank"] == "driver" and s["name"] == "attempt"}
+    wrk = [s for s in spans if s["rank"] != "driver"
+           and s["name"] == "attempt"]
+    assert len(drv) == 3 and len(wrk) == 3
+    assert all(s["parent_id"] in drv for s in wrk)
+    # both reshard transitions appear as spans (replan and/or the
+    # resharded restore — the 8->4 AND the 4->8)
+    reshard_pairs = {(s.get("from_devices"), s.get("to_devices"))
+                     for s in spans if s["name"] == "reshard"}
+    assert (8, 4) in reshard_pairs and (4, 8) in reshard_pairs
+    # restore-level reshard witness fired on a resumed attempt
+    assert any(s["name"] == "reshard" and s.get("where") == "restore"
+               for s in spans)
+
+    rep = build_report(obs_dir)
+    assert rep["critical_path_ok"] is True
+    for a in rep["attempts"]:
+        cp = a.get("critical_path")
+        assert cp is not None and cp["reconciliation"]["ok"], a
+        # the exact contract: span-derived terms == the rank's ledger
+        for term, d in cp["reconciliation"]["deltas"].items():
+            assert abs(d) <= 1e-6 * max(1.0, cp["wall_s"]), (term, cp)
+    # the serve request decomposes end-to-end in the trace section
+    sv = rep["trace"]["serve"]
+    assert sv["requests"] == 1
+    ex = sv["slowest"]
+    assert ex["rid"] == "drill0" and ex["generated"] == 4
+    for phase in ("enqueue_s", "prefill_s", "decode_s"):
+        assert phase in ex and ex[phase] >= 0
+    assert ex["iterations"] >= 1
+
+    # CLI rc=0 with the critical path present (rc=3 has teeth: a
+    # doctored span stream must trip it — drilled in test_trace.py)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "report", obs_dir, "--text"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip())
+    assert summary["critical_path_ok"] and summary["spans"] > 0
+    assert "critical path" in r.stderr     # the --text flame summary
+
+    # obs diff: self-vs-self is clean; a doctored goodput regression
+    # trips with the offending term named
+    flat = flatten_report(rep)
+    assert flat["n_attempts"] == 3 and flat["reshards"] == 2
+    assert "cp_frac_step_s" in flat or "cp_frac_compile_s" in flat
+    assert diff_flat(flat, flat) == []
+    doctored = dict(flat)
+    doctored["goodput_frac"] = flat["goodput_frac"] * 0.3
+    viols = diff_flat(doctored, flat)
+    assert viols and any("goodput_frac" in v for v in viols)
+
+    report_path = os.path.join(obs_dir, "report.json")
+    import json as _json
+    with open(report_path, "w") as f:
+        _json.dump(rep, f, default=str)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", report_path, report_path],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
 
 
 # ---------------------------------------------------------------------------

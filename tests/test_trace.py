@@ -1,0 +1,456 @@
+"""Causal span tracing + critical path + `obs diff` (ISSUE 14).
+
+Unit-level contracts (the drill-level acceptance lives in
+tests/test_obs.py::test_trace_critical_path_and_diff_on_elastic_drill):
+the trace schema is pinned both directions, trace context propagates
+across the trainer's worker-spawn env forwarding, driverless
+multi-rank sessions merge to ONE trace, the critical-path
+reconciliation has teeth (a doctored span stream exits 3), and the
+`obs diff` regression gate holds its rc contract on the checked-in
+fixture ledgers.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gke_ray_train_tpu.obs import runtime as obs_runtime
+from gke_ray_train_tpu.obs import trace as obs_trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_session(monkeypatch):
+    obs_runtime.end_attempt("test-cleanup")
+    for k in ("OBS_RUN_ID", "OBS_ATTEMPT", "OBS_DIR", "OBS_PARENT_SPAN",
+              "TRACE"):
+        monkeypatch.delenv(k, raising=False)
+    yield
+    obs_runtime.end_attempt("test-cleanup")
+
+
+# ---------------------------------------------------------------------------
+# schema + span log contracts
+# ---------------------------------------------------------------------------
+
+def test_trace_schema_pinned_both_directions():
+    assert obs_trace.check_schema() == []
+    assert obs_trace.SPAN_STAMP == (
+        "trace_id", "span_id", "parent_id", "name", "run_id",
+        "attempt", "rank", "slice", "step", "t0", "t1", "dur_s")
+    with pytest.raises(obs_trace.SpanError):
+        obs_trace.validate_span("made_up_span", {})
+    with pytest.raises(obs_trace.SpanError):
+        obs_trace.validate_span("compile", {"stray": 1})
+    obs_trace.validate_span("serve_decode", {"rid": "r", "iterations": 3})
+    # the schema FILE must drift when the code does (both directions)
+    doc = obs_trace.load_schema()
+    assert set(doc["names"]) == set(obs_trace.SPAN_NAMES)
+
+
+def test_span_term_mapping_pins_ledger_terms():
+    """critical.py's span->term mapping is a jax-free string copy of
+    the ledger vocabulary — pin it against the real LEDGER_TERMS."""
+    from gke_ray_train_tpu.obs import critical
+    from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+    assert set(critical.SPAN_TERM.values()) <= set(LEDGER_TERMS)
+    assert set(critical.RECONCILED_TERMS) <= set(LEDGER_TERMS)
+    # every term-mapped span name is in the pinned schema vocabulary
+    assert set(critical.SPAN_TERM) <= set(obs_trace.SPAN_NAMES)
+
+
+def test_span_log_roundtrip_and_deterministic_trace_id(tmp_path):
+    a = obs_trace.SpanLog(obs_trace.spans_path(str(tmp_path), 0),
+                          run_id="runA", attempt=1, rank=0)
+    rec = a.emit("compile", 1.5, step=3)
+    child = a.emit("serve_prefill", 0.2, parent_id=rec["span_id"],
+                   rid="r0")
+    a.close()
+    # a second process that only knows the run id joins the same trace
+    assert obs_trace.trace_id_for_run("runA") == rec["trace_id"]
+    spans = list(obs_trace.iter_spans(str(tmp_path)))
+    assert [s["name"] for s in spans] in (
+        [rec["name"], "serve_prefill"], ["serve_prefill", rec["name"]])
+    got = {s["span_id"]: s for s in spans}
+    assert got[child["span_id"]]["parent_id"] == rec["span_id"]
+    assert got[rec["span_id"]]["dur_s"] == 1.5
+    assert got[rec["span_id"]]["t1"] - got[rec["span_id"]]["t0"] == \
+        pytest.approx(1.5, abs=2e-6)
+    # corrupt lines are skipped, never fatal (SIGKILL mid-write)
+    with open(obs_trace.spans_path(str(tmp_path), 0), "a") as f:
+        f.write('{"torn...\n')
+    assert len(list(obs_trace.iter_spans(str(tmp_path)))) == 2
+
+
+def test_emit_site_schema_teeth_through_runtime(tmp_path):
+    run = obs_runtime.start_attempt(obs_dir=str(tmp_path))
+    try:
+        with pytest.raises(obs_trace.SpanError):
+            run.span_add("not_a_span", 0.1)
+        with pytest.raises(obs_trace.SpanError):
+            run.span_add("eval", 0.1, undeclared_attr=1)
+    finally:
+        obs_runtime.end_attempt("ok")
+
+
+# ---------------------------------------------------------------------------
+# trace-context propagation (the satellite drill)
+# ---------------------------------------------------------------------------
+
+def test_parent_span_survives_worker_env_forwarding(tmp_path):
+    """The trainer's fake-ray worker spawn path: the driver mints an
+    attempt span id, _pool_env forwards it as OBS_PARENT_SPAN through
+    _run_worker's os.environ.update, and the worker's attempt span
+    parents under it — the merged DAG is connected across the spawn
+    boundary."""
+    from gke_ray_train_tpu.rayint import JaxTrainer
+    obs_dir = str(tmp_path / "obs")
+    seen = {}
+
+    def worker(config):
+        seen["parent_env"] = os.environ.get("OBS_PARENT_SPAN")
+        run = obs_runtime.active()
+        assert run is not None and run.spans is not None
+        run.span_add("compile", 0.01)
+        return {"ok": 1}
+
+    res = JaxTrainer(worker, use_ray=False,
+                     train_loop_config={"OBS": "1", "OBS_DIR": obs_dir,
+                                        "OBS_CAPTURE": "0"}).fit()
+    assert res.error is None
+    spans = list(obs_trace.iter_spans(obs_dir))
+    drv_att = [s for s in spans if s["rank"] == "driver"
+               and s["name"] == "attempt"]
+    wrk_att = [s for s in spans if s["rank"] == 0
+               and s["name"] == "attempt"]
+    run_span = [s for s in spans if s["name"] == "run"]
+    assert len(drv_att) == len(wrk_att) == len(run_span) == 1
+    # the env actually carried the driver's minted id
+    assert seen["parent_env"] == drv_att[0]["span_id"]
+    assert wrk_att[0]["parent_id"] == drv_att[0]["span_id"]
+    assert drv_att[0]["parent_id"] == run_span[0]["span_id"]
+    # one trace across driver + worker
+    assert len({s["trace_id"] for s in spans}) == 1
+    # leaf spans parent under the worker's attempt span
+    leaf = [s for s in spans if s["name"] == "compile"][0]
+    assert leaf["parent_id"] == wrk_att[0]["span_id"]
+
+
+def test_driverless_multirank_merges_to_one_trace(tmp_path, monkeypatch):
+    """No driver at all: ranks that share OBS_RUN_ID derive the SAME
+    trace id (it is a hash of the run id, not minted state), so the
+    merged stream is one trace with one attempt span per rank."""
+    monkeypatch.setenv("OBS_RUN_ID", "sharedrun")
+    for rank in (0, 1, 2):
+        obs_runtime.start_attempt(obs_dir=str(tmp_path), rank=rank)
+        obs_runtime.span_add("compile", 0.01 * (rank + 1))
+        obs_runtime.end_attempt("ok")
+    spans = list(obs_trace.iter_spans(str(tmp_path)))
+    assert {s["trace_id"] for s in spans} == \
+        {obs_trace.trace_id_for_run("sharedrun")}
+    atts = [s for s in spans if s["name"] == "attempt"]
+    assert sorted(s["rank"] for s in atts) == [0, 1, 2]
+    # driverless = no parent to adopt
+    assert all(s["parent_id"] is None for s in atts)
+
+
+def test_trace_off_keeps_events_on(tmp_path, monkeypatch):
+    monkeypatch.setenv("TRACE", "0")
+    run = obs_runtime.start_attempt(obs_dir=str(tmp_path))
+    assert run.spans is None
+    assert run.span_add("compile", 0.1) is None     # silent no-op
+    run.emit("attempt_start", topology="cpu-8")
+    obs_runtime.end_attempt("ok")
+    assert os.path.exists(tmp_path / "events-r0.jsonl")
+    assert not os.path.exists(tmp_path / "spans-r0.jsonl")
+    assert list(obs_trace.iter_spans(str(tmp_path))) == []
+
+
+def test_trace_plan_knob_three_dialects():
+    from gke_ray_train_tpu.plan import ExecutionPlan
+    via_json = ExecutionPlan.from_config({"TRACE": False})
+    via_env = ExecutionPlan.from_env({"TRACE": "off"})
+    via_kw = ExecutionPlan.from_kwargs(trace=False)
+    assert via_json == via_env == via_kw
+    assert via_json.fingerprint() == via_kw.fingerprint()
+    assert ExecutionPlan().trace is True
+    # operational like every obs knob: toggling tracing must never
+    # stale a compiled artifact on either surface
+    base = ExecutionPlan()
+    for surface in ("train", "serve", "all"):
+        assert base.compile_fingerprint(surface) == \
+            via_kw.compile_fingerprint(surface)
+
+
+# ---------------------------------------------------------------------------
+# critical path: teeth
+# ---------------------------------------------------------------------------
+
+def _fake_attempt(tmp_path, *, compile_span_s, ledger, run_id="runZ"):
+    """One driver attempt_end + one worker stream whose spans claim
+    ``compile_span_s`` for compile against ``ledger``."""
+    from gke_ray_train_tpu.obs.events import EventLog, events_path
+    drv = obs_runtime.DriverObs(str(tmp_path), run_id)
+    drv.begin_attempt(1)
+    wrk_events = EventLog(events_path(str(tmp_path), 0), run_id=run_id,
+                          attempt=1, rank=0)
+    wrk_events.emit("worker_exit", status="ok",
+                    goodput={k: v for k, v in ledger.items()
+                             if k != "wall_s"})
+    wrk_events.close()
+    spans = obs_trace.SpanLog(obs_trace.spans_path(str(tmp_path), 0),
+                              run_id=run_id, attempt=1, rank=0)
+    att = spans.emit("attempt", ledger["wall_s"])
+    spans.emit("compile", compile_span_s, parent_id=att["span_id"])
+    spans.emit("step_window", ledger["step_s"], steps=4,
+               data_stall_s=0.0, parent_id=att["span_id"])
+    spans.close()
+    drv.note_attempt(1, {"status": "ok", "goodput": ledger})
+    drv.close()
+
+
+LEDGER = {"compile_s": 1.0, "restore_s": 0.0, "fast_forward_s": 0.0,
+          "data_stall_s": 0.0, "eval_ckpt_stall_s": 0.0, "step_s": 2.0,
+          "lost_s": 1.0, "wall_s": 4.0}
+
+
+def test_critical_path_reconciles_and_doctored_trips(tmp_path):
+    from gke_ray_train_tpu.obs.report import build_report
+    ok_dir = tmp_path / "ok"
+    ok_dir.mkdir()
+    _fake_attempt(ok_dir, compile_span_s=1.0, ledger=LEDGER)
+    rep = build_report(str(ok_dir))
+    cp = rep["attempts"][0]["critical_path"]
+    assert rep["critical_path_ok"] and cp["reconciliation"]["ok"]
+    assert cp["span_terms"]["compile_s"] == 1.0
+    # the terms ARE the reconciled ledger identity: they sum to wall
+    terms = cp["terms"]
+    assert sum(terms[t] for t in
+               ("compile_s", "restore_s", "fast_forward_s",
+                "data_stall_s", "eval_ckpt_stall_s", "step_s",
+                "lost_s")) == pytest.approx(terms["wall_s"])
+
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    _fake_attempt(bad_dir, compile_span_s=1.7, ledger=LEDGER)
+    rep = build_report(str(bad_dir))
+    cp = rep["attempts"][0]["critical_path"]
+    assert rep["critical_path_ok"] is False
+    assert not cp["reconciliation"]["ok"]
+    assert cp["reconciliation"]["deltas"]["compile_s"] == \
+        pytest.approx(0.7)
+    # ...and the CLI turns that into rc 3 (report.py's discipline)
+    env = dict(os.environ, PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "report", str(bad_dir)],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 3
+    assert "critical-path" in r.stderr
+
+
+def test_critical_rank_is_the_straggler(tmp_path):
+    """Multi-rank: the critical path belongs to the rank whose attempt
+    span ran longest, and reconciliation uses THAT rank's own ledger."""
+    from gke_ray_train_tpu.obs.critical import critical_path
+    spans = []
+    for rank, wall, comp in ((0, 2.0, 0.5), (1, 3.0, 1.5)):
+        log = obs_trace.SpanLog(
+            obs_trace.spans_path(str(tmp_path), rank),
+            run_id="r", attempt=1, rank=rank)
+        att = log.emit("attempt", wall)
+        spans.append(att)
+        spans.append(log.emit("compile", comp,
+                              parent_id=att["span_id"]))
+        log.close()
+    ledgers = {0: {"compile_s": 0.5}, 1: {"compile_s": 1.5}}
+    cp = critical_path(spans, {"wall_s": 3.5, "compile_s": 0.5},
+                       ledgers)
+    assert cp["rank"] == 1
+    assert cp["span_terms"]["compile_s"] == 1.5
+    assert cp["reconciliation"]["ok"]       # vs rank 1's OWN ledger
+
+
+# ---------------------------------------------------------------------------
+# obs diff: rc contract on the checked-in fixtures
+# ---------------------------------------------------------------------------
+
+def test_reused_obs_dir_two_runs_stay_reconciled(tmp_path):
+    """Span/event files open in append mode and the default obs dir is
+    run-stable: a SECOND run into the same dir must not merge its
+    attempt-1 spans with the first run's (grouping is per run_id) —
+    the reconciliation gate must stay green on healthy telemetry."""
+    from gke_ray_train_tpu.obs.report import build_report
+    for run_id in ("runFirst", "runSecond"):
+        _fake_attempt(tmp_path, compile_span_s=1.0, ledger=LEDGER,
+                      run_id=run_id)
+    rep = build_report(str(tmp_path))
+    assert rep["critical_path_ok"] is True
+    for a in rep["attempts"]:
+        cp = a.get("critical_path")
+        assert cp is not None and cp["reconciliation"]["ok"], a
+        # one run's spans only: compile counted once, not twice
+        assert cp["span_terms"]["compile_s"] == 1.0
+
+
+def test_diff_trips_on_recorded_field_missing_from_fresh():
+    """A recorded field vanishing from the fresh report (tracing
+    silently off, serving gone) is a VIOLATION, not a silent skip —
+    the exact regression class the gate exists for."""
+    from gke_ray_train_tpu.obs.diff import diff_flat
+    recorded = {"goodput_frac": 0.5, "n_attempts": 1.0,
+                "cp_frac_compile_s": 0.4}
+    fresh = {"goodput_frac": 0.5, "n_attempts": 1.0}   # no cp_* at all
+    viols = diff_flat(fresh, recorded)
+    assert viols and "cp_frac_compile_s" in viols[0]
+    assert "MISSING" in viols[0]
+    # a noise-floored recorded field missing from fresh is NOT a trip
+    recorded_small = {"goodput_frac": 0.5, "n_attempts": 1.0,
+                      "cp_frac_restore_s": 0.003}
+    assert diff_flat(fresh, recorded_small) == []
+    # ungated extras (e.g. `anomalies`) stay informational
+    assert diff_flat(fresh, {**fresh, "anomalies": 2.0}) == []
+
+
+def test_diff_fixture_rc_contract():
+    """The exact commands CI runs: identical recorded reports diff to
+    rc 0; the doctored goodput regression exits nonzero with the
+    offending term named."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    fix = os.path.join(REPO, "tests", "regressions", "elastic_cpu8.json")
+    doctored = os.path.join(REPO, "tests", "regressions",
+                            "elastic_cpu8_doctored.json")
+    assert os.path.exists(fix) and os.path.exists(doctored)
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", fix, fix],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip())["ok"] is True
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", doctored, fix],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 4, (r.stdout, r.stderr)
+    assert "goodput_frac" in r.stderr       # offending term named
+    # unreadable operand = rc 1, never a crash
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", "/nonexistent", fix],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 1
+
+
+def test_diff_update_records_ledger(tmp_path):
+    """REGRESSION_UPDATE / --update re-records the B side from A and
+    preserves any tolerance overrides the old ledger carried."""
+    from gke_ray_train_tpu.obs.diff import diff_flat
+    env = dict(os.environ, PYTHONPATH=REPO)
+    ledger_path = str(tmp_path / "ledger.json")
+    with open(ledger_path, "w") as f:
+        json.dump({"goodput_frac": 0.9, "n_attempts": 1.0,
+                   "tolerances": {"goodput_frac": 0.01}}, f)
+    flat_path = str(tmp_path / "fresh.json")
+    with open(flat_path, "w") as f:
+        # the A side carries its OWN tolerances key: the re-record must
+        # keep B's reviewed overrides, not silently adopt A's
+        json.dump({"goodput_frac": 0.5, "n_attempts": 2.0,
+                   "tolerances": {"goodput_frac": 0.9}}, f)
+    # tightened tolerance applies before the re-record (2.2% drift
+    # against the ledger's own 1% override)
+    with open(ledger_path) as f:
+        assert diff_flat({"goodput_frac": 0.88}, json.load(f))
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", flat_path, ledger_path, "--update"],
+                       capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    doc = json.load(open(ledger_path))
+    assert doc["goodput_frac"] == 0.5 and doc["n_attempts"] == 2.0
+    assert doc["tolerances"] == {"goodput_frac": 0.01}  # preserved
+    assert "_note" in doc
+    # the env spelling drives the same path
+    r = subprocess.run([sys.executable, "-m", "gke_ray_train_tpu.obs",
+                        "diff", flat_path, ledger_path],
+                       capture_output=True, text=True,
+                       env={**env, "REGRESSION_UPDATE": "1"})
+    assert r.returncode == 0, r.stderr
+
+
+def test_diff_noise_floor_and_named_terms():
+    from gke_ray_train_tpu.obs.diff import diff_flat
+    # both sides under the floor: composition jitter is not a finding
+    a = {"frac_compile_s": 0.004, "n_attempts": 1.0}
+    b = {"frac_compile_s": 0.015, "n_attempts": 1.0}
+    assert diff_flat(a, b) == []
+    # above the floor the two-sided comparator has teeth, named
+    a = {"frac_compile_s": 0.60, "n_attempts": 1.0}
+    b = {"frac_compile_s": 0.25, "n_attempts": 1.0}
+    viols = diff_flat(a, b)
+    assert viols and "frac_compile_s" in viols[0]
+    # counts are exact in BOTH directions
+    assert diff_flat({"n_attempts": 2.0}, {"n_attempts": 3.0})
+    assert diff_flat({"n_attempts": 3.0}, {"n_attempts": 2.0})
+
+
+# ---------------------------------------------------------------------------
+# satellites: histogram reservoir + bench run_id
+# ---------------------------------------------------------------------------
+
+def test_histogram_reservoir_spans_whole_run():
+    """The satellite fix: past the cap the sample is a uniform
+    reservoir over the WHOLE run — a long run's p50/p99 must reflect
+    both its early and late regimes (the old scheme forgot one side).
+    Deterministic: the replacement stream is a fixed-seed LCG."""
+    from gke_ray_train_tpu.obs.metrics import Histogram
+    h = Histogram("step_time_s", max_samples=256)
+    for _ in range(5000):
+        h.observe(0.001)
+    for _ in range(5000):
+        h.observe(1.0)
+    snap = h.snapshot()
+    assert snap["count"] == 10000
+    assert snap["sum"] == pytest.approx(5000 * 1.001)
+    fast = sum(1 for v in h._samples if v < 0.5)
+    # a uniform reservoir holds ~50% early samples (binomial, n=256);
+    # the old rotating window held 0% and the pre-fix frozen sample
+    # held 100% — both far outside this band
+    assert 0.25 * len(h._samples) < fast < 0.75 * len(h._samples)
+    # and the export still carries _count/_sum so scrapers can rate()
+    from gke_ray_train_tpu.obs.metrics import MetricsRegistry
+    reg = MetricsRegistry()
+    for v in (0.1, 0.2):
+        reg.histogram("step_time_s").observe(v)
+    prom = reg.to_prometheus()
+    assert "grt_step_time_s_count 2" in prom
+    assert "grt_step_time_s_sum 0.3" in prom
+    # determinism: same observations -> bitwise-same reservoir
+    h2 = Histogram("step_time_s", max_samples=256)
+    for _ in range(5000):
+        h2.observe(0.001)
+    for _ in range(5000):
+        h2.observe(1.0)
+    assert h2._samples == h._samples
+
+
+def test_bench_emit_stamps_run_id(monkeypatch, capsys):
+    """The satellite: bench records carry a run identity even with no
+    active obs session (process-stable), and an exported OBS_RUN_ID
+    always wins — `obs diff`/report merges key A/B arms by it."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.remove(REPO)
+    monkeypatch.delenv("OBS_RUN_ID", raising=False)
+    monkeypatch.delenv("OBS_DIR", raising=False)
+    bench._BENCH_RUN_ID = None
+    bench._emit("m", 1.0, "u", {}, compare_baseline=False)
+    bench._emit("m2", 2.0, "u", {}, compare_baseline=False)
+    recs = [json.loads(ln) for ln in
+            capsys.readouterr().out.strip().splitlines()]
+    assert recs[0]["run_id"] and recs[0]["run_id"] == recs[1]["run_id"]
+    monkeypatch.setenv("OBS_RUN_ID", "job-level-id")
+    bench._emit("m3", 3.0, "u", {}, compare_baseline=False)
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["run_id"] == "job-level-id"
