@@ -43,7 +43,12 @@ the CPU mesh),
 ``overlap`` (OVERLAP=off vs =manual A/B through make_train_step:
 bitwise-identical loss streams asserted, per-arm tokens/sec and the
 scheduled-HLO overlap evidence — overlap_frac / exposed collective
-bytes — on one record; the cost-model half survives a dead backend).
+bytes — on one record; the cost-model half survives a dead backend),
+``autotune`` (default-vs-tuned A/B through the autotune search on the
+canonical CPU mesh: the winner over the tiny_fsdp8 base plan, per-arm
+StepCostReport + exposed bytes + plan fingerprints, modeled step-time
+improvement as the value, and the tuned arm's real loss stream
+asserted valid against the default arm's trajectory shape).
 
 Dead-accelerator behavior: when the backend probe fails, the bench
 re-execs itself on the 8-fake-device CPU mesh and still emits a VALID
@@ -1264,6 +1269,114 @@ def bench_dcn():
         compare_baseline=False)
 
 
+def bench_autotune():
+    """BENCH_MODE=autotune: default-vs-tuned A/B through the autotune
+    search (autotune/) on the canonical 8-fake-device CPU mesh (re-execs
+    itself there, like the dcn/elastic modes). One record carries the
+    search verdict AND the evidence: the winner found over the
+    tiny_fsdp8 base plan, per-arm StepCostReport summaries + exposed
+    collective bytes + plan fingerprints, modeled step times from the
+    same ChipSpec scorer the registry persists, and both arms' REAL
+    5-step loss streams — the tuned arm's trajectory asserted valid
+    against the default arm's shape (finite, decreasing, within
+    tolerance of the default stream: a tuned plan that "wins" the cost
+    model by wrecking the optimization trajectory must fail here).
+    value = modeled step-time improvement (default / tuned; >= 1.0 by
+    construction — the default is candidate 0 of its own space)."""
+    import dataclasses as _dc
+
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) != 8:
+        import subprocess
+
+        from gke_ray_train_tpu.perf.cache import cpu_mesh_env
+        env = cpu_mesh_env(BENCH_MODE="autotune")
+        env.pop("GRAFT_FORCE_PROBE", None)
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__))).returncode)
+
+    from gke_ray_train_tpu.autotune.search import search
+    from gke_ray_train_tpu.autotune.space import TUNABLE_FIELDS
+    from gke_ray_train_tpu.perf.budget import (
+        plan_for_preset, preset_model_cfg)
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    # the compile-heavy dims; batch/prefetch arms cannot move the score
+    # on this space (product 1 / operational) and flash has no Pallas
+    # attention grid on the cpu family
+    result = search(base, cfg, dims=["mesh", "sync", "fused"])
+    tuned = _dc.replace(base, **{
+        f: result["winner_tuned_fields"][f]
+        for f in TUNABLE_FIELDS["train"]})
+
+    steps = 5
+    B, S = base.global_batch(), base.max_seq_len
+
+    def run_arm(plan):
+        mesh = plan.build_mesh(devices)
+        opt = make_optimizer(3e-4)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
+        batch = jax.device_put(_rand_batch(B, S, cfg.vocab_size),
+                               plan.batch_shardings(mesh))
+        state, m = step(state, batch)          # compile + warmup
+        jax.block_until_ready(m["loss"])
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = step(state, batch)
+            losses.append(m["loss"])
+        losses = [float(v) for v in jax.device_get(losses)]
+        dt = max(time.perf_counter() - t0, 1e-9)
+        return losses, steps * B * S / dt / len(devices)
+
+    loss_default, tps_default = run_arm(base)
+    loss_tuned, tps_tuned = run_arm(tuned)
+    # trajectory-shape assertion: finite, decreasing like the default,
+    # and pointwise within 5% of the default stream (the arms share
+    # init, data and global batch; only the partitioning differs)
+    import math as _math
+    valid = (all(_math.isfinite(v) for v in loss_tuned)
+             and loss_tuned[-1] < loss_tuned[0]
+             and loss_default[-1] < loss_default[0]
+             and all(abs(t - d) <= 0.05 * max(abs(d), 1e-9)
+                     for t, d in zip(loss_tuned, loss_default)))
+    if not valid:
+        print(f"bench autotune: TUNED LOSS TRAJECTORY INVALID "
+              f"default={loss_default} tuned={loss_tuned}",
+              file=sys.stderr)
+    _emit(
+        f"autotune default-vs-tuned modeled step time "
+        f"({result['space']['scored']} candidates scored / "
+        f"{result['space']['compiled']} compiled over tiny_fsdp8, "
+        f"{devices[0].device_kind} x{len(devices)})",
+        result["improvement"], "x",
+        {"modeled_step_s_default":
+             result["base"]["score"]["modeled_step_s"],
+         "modeled_step_s_tuned":
+             result["winner"]["score"]["modeled_step_s"],
+         "winner_diff": result["winner"]["diff"],
+         "plan_fingerprint_default": result["base"]["plan_fingerprint"],
+         "plan_fingerprint_tuned": result["winner"]["plan_fingerprint"],
+         "exposed_collective_bytes_default":
+             result["base"]["report"]["exposed_collective_bytes"],
+         "exposed_collective_bytes_tuned":
+             result["winner"]["report"]["exposed_collective_bytes"],
+         "cost_report_default": result["base"]["report"],
+         "cost_report_tuned": result["winner"]["report"],
+         "loss_stream_default": loss_default,
+         "loss_stream_tuned": loss_tuned,
+         "loss_trajectory_valid": valid,
+         "tokens_per_sec_per_chip_default": round(tps_default, 1),
+         "tokens_per_sec_per_chip_tuned": round(tps_tuned, 1),
+         "space": result["space"]},
+        compare_baseline=False)
+
+
 def bench_serve():
     """BENCH_MODE=serve: the continuous-batching engine A/B
     (serve/engine.py). One JSON line carries BOTH serving throughputs —
@@ -1493,6 +1606,7 @@ def main():
      "decode": bench_decode,
      "overlap": bench_overlap,
      "dcn": bench_dcn,
+     "autotune": bench_autotune,
      "serve": bench_serve}[mode]()
 
 

@@ -1,0 +1,48 @@
+"""Cost-model-driven ExecutionPlan search + tuned-plan registry.
+
+Closes the gap between "every performance lever is a validated
+:class:`~gke_ray_train_tpu.plan.ExecutionPlan` field" and "someone
+still pins them by hand": the search enumerates candidate plans around
+a declared base (:mod:`space`), prunes them with the repo's own static
+checkers before any compile, compiles each survivor once on the
+canonical CPU mesh and scores it with the HLO cost model the budget
+suite already trusts (:mod:`score`), picks a winner deterministically
+(:mod:`search`), and persists it keyed by (model digest, topology,
+surface) so ``AUTOTUNE=1`` runs overlay it at startup (:mod:`registry`).
+
+CLI: ``python -m gke_ray_train_tpu.autotune search|score|apply|explain``.
+
+Re-exports are LAZY (PEP 562): the registry's ``maybe_apply`` is
+called from the driver-side trainer, which must not drag jax in at
+import time; ``__main__`` doubles as a runpy target.
+"""
+
+_LAZY_EXPORTS = {
+    # space
+    "Candidate": "space", "Space": "space", "TUNABLE_FIELDS": "space",
+    "enumerate_space": "space",
+    # score
+    "SCORER_VERSION": "score", "chip_for_plan": "score",
+    "coarse_score": "score", "modeled_step_time": "score",
+    "score_candidate": "score",
+    # search
+    "search": "search", "search_budget": "search",
+    # registry
+    "apply_entry": "registry", "entry_key": "registry",
+    "load_entry": "registry", "maybe_apply": "registry",
+    "model_digest": "registry", "registry_dir": "registry",
+    "save_entry": "registry", "validate_entry": "registry",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_EXPORTS:
+        import importlib
+        mod = importlib.import_module(
+            f"{__name__}.{_LAZY_EXPORTS[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY_EXPORTS))

@@ -1,0 +1,292 @@
+"""Tuned-plan registry: persist winners, overlay them at run time.
+
+A search result is persisted as ONE JSON entry keyed by
+``(model-config digest, topology, surface)`` — the identity triple
+under which the score is meaningful — with the full scored-candidate
+table beside it (``<key>.candidates.json``) so the verdict stays
+auditable. ``AUTOTUNE=1`` (plan field) lets ``_run_worker`` and both
+ray-jobs entries overlay a registry hit onto the resolved plan:
+
+- the overlay writes ONLY the surface's tunable fields
+  (:data:`~gke_ray_train_tpu.autotune.space.TUNABLE_FIELDS`) — it can
+  never touch operational identity (obs dirs, cache policy, guards);
+- application is LOUD (a warning-level line naming both fingerprints)
+  and REFUSED — run continues untuned, also loudly — when the tuned
+  plan no longer validates (plancheck/kernelcheck findings against the
+  current model) or the entry's fingerprint inputs drifted (model
+  digest, scorer version, chip spec);
+- an elastic reshard drops the overlay (``plan.replan``) and the next
+  attempt's ``maybe_apply`` re-keys against the survivors' topology —
+  a plan tuned for 8 devices can never silently ride a 4-device
+  attempt.
+
+The registry directory defaults to ``<repo>/tuned_plans`` and is
+overridable via ``AUTOTUNE_DIR`` (config key wins over env, like every
+knob).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from gke_ray_train_tpu.autotune.space import TUNABLE_FIELDS
+from gke_ray_train_tpu.autotune.score import SCORER_VERSION, chip_for_plan
+
+logger = logging.getLogger(__name__)
+
+REGISTRY_VERSION = 1
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_DIR = os.path.join(_REPO_ROOT, "tuned_plans")
+
+
+def registry_dir(config: Optional[Mapping[str, Any]] = None) -> str:
+    if config is not None and dict(config).get("AUTOTUNE_DIR"):
+        return str(dict(config)["AUTOTUNE_DIR"])
+    return os.environ.get("AUTOTUNE_DIR") or DEFAULT_DIR
+
+
+def model_digest(model_cfg) -> str:
+    """Stable 16-hex identity of the model the plan was tuned FOR — the
+    first key component. A tuned mesh/batch split is meaningless on a
+    different architecture; digest drift refuses the overlay."""
+    payload = json.dumps(model_cfg.to_dict(), sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def chip_digest(chip) -> str:
+    payload = json.dumps(dataclasses.asdict(chip), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+def entry_key(digest: str, topology: str, surface: str) -> str:
+    return f"{surface}-{topology}-{digest}"
+
+
+def entry_path(key: str, directory: Optional[str] = None) -> str:
+    return os.path.join(directory or registry_dir(), f"{key}.json")
+
+
+def save_entry(result: Dict[str, Any], *, base_plan, model_cfg,
+               directory: Optional[str] = None) -> str:
+    """Persist a search result as a registry entry + its candidate
+    table; returns the entry path."""
+    import jax
+
+    directory = directory or registry_dir()
+    digest = model_digest(model_cfg)
+    surface = result["surface"]
+    key = entry_key(digest, base_plan.topology, surface)
+    chip = chip_for_plan(base_plan)
+    doc = {
+        "_version": REGISTRY_VERSION,
+        "key": key,
+        "surface": surface,
+        "topology": base_plan.topology,
+        "model_digest": digest,
+        "model": model_cfg.to_dict(),
+        "fingerprint_inputs": {
+            "model_digest": digest,
+            "scorer_version": result.get("scorer_version",
+                                         SCORER_VERSION),
+            "chip": chip.name,
+            "chip_digest": chip_digest(chip),
+        },
+        "base_fingerprint": result["base"]["plan_fingerprint"],
+        "winner_fingerprint": result["winner"]["plan_fingerprint"],
+        "tuned": {f: result["winner_tuned_fields"][f]
+                  for f in TUNABLE_FIELDS[surface]},
+        "env": result.get("winner_env") or {},
+        "score": result["winner"]["score"],
+        "base_score": result["base"]["score"],
+        "improvement": result["improvement"],
+        "space": result["space"],
+        "candidates_file": f"{key}.candidates.json",
+        "_recorded_with": {"jax": jax.__version__},
+    }
+    os.makedirs(directory, exist_ok=True)
+    path = entry_path(key, directory)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    with open(os.path.join(directory, doc["candidates_file"]), "w") as f:
+        json.dump({"key": key, "candidates": result["candidates"],
+                   "pruned": result["pruned"]}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+    logger.info("autotune: recorded tuned plan %s -> %s (%.3fx)",
+                key, path, result["improvement"])
+    return path
+
+
+def load_entry(key: str, directory: Optional[str] = None
+               ) -> Optional[Dict[str, Any]]:
+    path = entry_path(key, directory)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning("autotune: registry entry %s unreadable (%s)",
+                       path, e)
+        return None
+
+
+def validate_entry(entry: Dict[str, Any], plan, model_cfg
+                   ) -> List[str]:
+    """Why this entry must NOT overlay this run (empty = applicable):
+    fingerprint-input drift, a tuned plan that no longer validates, or
+    static findings against the current model."""
+    out: List[str] = []
+    if entry.get("_version") != REGISTRY_VERSION:
+        out.append(f"registry version {entry.get('_version')} != "
+                   f"{REGISTRY_VERSION}")
+    fi = entry.get("fingerprint_inputs") or {}
+    if model_cfg is not None:
+        digest = model_digest(model_cfg)
+        if fi.get("model_digest") != digest:
+            out.append(f"model digest drifted: tuned for "
+                       f"{fi.get('model_digest')}, run resolves "
+                       f"{digest}")
+    if fi.get("scorer_version") != SCORER_VERSION:
+        out.append(f"scorer version drifted: entry "
+                   f"{fi.get('scorer_version')} vs current "
+                   f"{SCORER_VERSION} — re-tune")
+    chip = chip_for_plan(plan)
+    if fi.get("chip_digest") != chip_digest(chip):
+        out.append(f"chip spec drifted for family {chip.name!r} — the "
+                   "scores no longer describe this hardware; re-tune")
+    if entry.get("topology") != plan.topology:
+        out.append(f"topology mismatch: tuned for "
+                   f"{entry.get('topology')}, plan declares "
+                   f"{plan.topology}")
+    if out:
+        return out
+    # the tuned plan itself must still validate end to end — through
+    # the SAME surface-aware gauntlet the enumerator pruned with
+    # (space.static_findings skips the mesh arithmetic on the serve
+    # surface: a serving replica's decode is mesh-local by design)
+    from gke_ray_train_tpu.autotune.space import static_findings
+    from gke_ray_train_tpu.plan import PlanError
+    try:
+        tuned = _overlay(plan, entry)
+    except PlanError as e:
+        return [f"tuned plan no longer validates: {e}"]
+    if entry.get("surface", "train") == "train":
+        # the search preserves ITS base's global batch by construction
+        # (space.py); the overlay must preserve THIS run's too. With
+        # data x fsdp fixed by the chip count, that reduces to the
+        # (per_device_batch x grad_accum) product — an entry searched
+        # against a different configured batch must not silently move
+        # the run's optimization trajectory.
+        t = entry.get("tuned") or {}
+        entry_rows = (int(t.get("per_device_batch",
+                                plan.per_device_batch))
+                      * int(t.get("grad_accum", plan.grad_accum)))
+        run_rows = plan.per_device_batch * plan.grad_accum
+        if entry_rows != run_rows:
+            out.append(
+                f"tuned batch split (per_device_batch x grad_accum = "
+                f"{entry_rows}) does not preserve this run's "
+                f"configured product ({run_rows}) — the entry was "
+                "searched against a different base batch; re-tune")
+    stray_env = sorted(set(entry.get("env") or {})
+                       - set(_env_override_keys()))
+    if stray_env:
+        out.append(
+            f"entry carries undeclared env overrides {stray_env} "
+            f"(allowed: {list(_env_override_keys())}) — refusing "
+            "to export them into the worker")
+    if out:
+        return out
+    return static_findings(tuned, model_cfg,
+                           surface=entry.get("surface", "train"))
+
+
+def _env_override_keys() -> Tuple[str, ...]:
+    from gke_ray_train_tpu.autotune.space import ENV_OVERRIDE_KEYS
+    return ENV_OVERRIDE_KEYS
+
+
+def _overlay(plan, entry: Dict[str, Any]):
+    surface = entry.get("surface", "train")
+    fields = {f: v for f, v in (entry.get("tuned") or {}).items()
+              if f in TUNABLE_FIELDS.get(surface, ())}
+    return dataclasses.replace(plan, **fields)
+
+
+def apply_entry(plan, entry: Dict[str, Any]):
+    """The validated overlay: tunable fields written onto the runtime
+    plan, the pre-overlay plan stashed so ``plan.replan`` can drop the
+    tune on a reshard (the re-key contract)."""
+    tuned = _overlay(plan, entry)
+    object.__setattr__(tuned, "_tuned_base", plan)
+    object.__setattr__(tuned, "_tuned_key", entry.get("key"))
+    return tuned
+
+
+def maybe_apply(plan, *, config: Optional[Mapping[str, Any]] = None,
+                model_cfg=None, surface: str = "train",
+                log: Optional[logging.Logger] = None
+                ) -> Tuple[Any, bool]:
+    """(plan, applied) — the runtime hook ``_run_worker`` and both
+    entry points call after plan resolution (and after any elastic
+    replan, so the lookup keys on the topology the attempt actually
+    runs). No-op unless the plan opted in via ``AUTOTUNE=1``."""
+    log = log or logger
+    if not getattr(plan, "autotune", False):
+        return plan, False
+    if model_cfg is None:
+        try:
+            from gke_ray_train_tpu.analysis.plancheck import (
+                model_config_for)
+            model_cfg = model_config_for(dict(config or {}), plan)
+        except Exception as e:  # noqa: BLE001 - static derivation only
+            log.warning("autotune: model config underivable (%s); "
+                        "running untuned", e)
+            return plan, False
+    if model_cfg is None:
+        log.warning(
+            "autotune: AUTOTUNE=1 but no statically-derivable model "
+            "config (no MODEL_ID/SMOKE_TEST) — registry keys on the "
+            "model digest; running untuned")
+        return plan, False
+    directory = registry_dir(config)
+    key = entry_key(model_digest(model_cfg), plan.topology, surface)
+    entry = load_entry(key, directory)
+    if entry is None:
+        log.warning("autotune: no tuned plan for %s under %s; running "
+                    "untuned (record one: python -m "
+                    "gke_ray_train_tpu.autotune search)", key, directory)
+        return plan, False
+    findings = validate_entry(entry, plan, model_cfg)
+    if findings:
+        log.warning(
+            "autotune: REFUSING tuned plan %s — %s; running untuned "
+            "(re-tune or remove the stale entry)", key,
+            "; ".join(findings[:3]))
+        return plan, False
+    tuned = apply_entry(plan, entry)
+    # export the entry's env-dialect knobs (validated above against
+    # ENV_OVERRIDE_KEYS). Attempt-scoped: _run_worker restores these
+    # keys in its finally, so a dropped overlay's flash blocks never
+    # leak into a later in-process attempt that runs untuned.
+    for k, v in (entry.get("env") or {}).items():
+        os.environ[k] = str(v)
+    log.warning(
+        "autotune: OVERLAY applied from %s — plan %s -> %s (tuned %s, "
+        "modeled %.3es vs default %.3es, %.3fx)", key,
+        plan.fingerprint(), tuned.fingerprint(),
+        {f: v for f, v in (entry.get("tuned") or {}).items()
+         if getattr(plan, f, None) != v} or "no field changes",
+        entry.get("score", {}).get("modeled_step_s", float("nan")),
+        entry.get("base_score", {}).get("modeled_step_s", float("nan")),
+        entry.get("improvement", float("nan")))
+    return tuned, True

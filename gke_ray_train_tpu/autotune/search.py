@@ -1,0 +1,193 @@
+"""Deterministic ExecutionPlan search: exhaustive or successive halving.
+
+Small spaces (≤ the compile budget) are searched EXHAUSTIVELY — every
+statically-feasible candidate gets its one compile. Larger spaces run
+deterministic successive halving: every candidate is ranked by the
+compile-free :func:`~gke_ray_train_tpu.autotune.score.coarse_score`
+proxy, and only the top ``budget`` (always including the base plan —
+the default must never win by being unsearched, nor lose unexamined)
+pay a full compile. The cut is LOGGED on the result (``space`` block
+names how many candidates each phase dropped) — no silent caps.
+
+Determinism contract (drilled by tests/test_autotune.py): the space is
+enumerated in a deterministic order, scores come from XLA's
+compile-time analyses of deterministic programs, and every ranking
+tie-breaks on (distance from base, fingerprint) — two runs over the
+same space produce a bitwise-identical winner and candidate table.
+
+Each scored candidate emits an ``autotune_candidate`` obs event (and
+the verdict an ``autotune_result``) when a telemetry session is active,
+so a tuning run leaves the same auditable event stream as a training
+run.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Dict, List, Mapping, Optional
+
+from gke_ray_train_tpu.autotune.space import (
+    Candidate, Space, TUNABLE_FIELDS, candidate_sort_key, distance,
+    enumerate_space)
+from gke_ray_train_tpu.autotune.score import (
+    SCORER_VERSION, chip_for_plan, coarse_score, rank_metric,
+    score_candidate)
+
+logger = logging.getLogger(__name__)
+
+# full compiles the search may spend before successive halving kicks
+# in; overridable per call or via AUTOTUNE_BUDGET
+DEFAULT_BUDGET = 64
+
+
+def search_budget(budget: Optional[int] = None,
+                  config: Optional[Mapping[str, Any]] = None) -> int:
+    if budget is not None:
+        return max(int(budget), 1)
+    raw = (dict(config).get("AUTOTUNE_BUDGET")
+           if config and "AUTOTUNE_BUDGET" in dict(config)
+           else os.environ.get("AUTOTUNE_BUDGET"))
+    try:
+        return max(int(raw), 1) if raw is not None else DEFAULT_BUDGET
+    except ValueError:
+        logger.warning("AUTOTUNE_BUDGET=%r is not an int; using %d",
+                       raw, DEFAULT_BUDGET)
+        return DEFAULT_BUDGET
+
+
+def _emit(kind: str, **payload: Any) -> None:
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
+    try:
+        obs_runtime.emit(kind, **payload)
+    except Exception as e:  # noqa: BLE001 - telemetry must not kill a search
+        logger.warning("autotune obs emit skipped: %s", e)
+
+
+def _plan_diff(plan, base, surface: str) -> Dict[str, Any]:
+    """The tunable fields a candidate changed, as {field: [base, cand]}
+    — the human-readable half of every table row."""
+    return {f: [getattr(base, f), getattr(plan, f)]
+            for f in TUNABLE_FIELDS[surface]
+            if getattr(plan, f) != getattr(base, f)}
+
+
+def search(base_plan, model_cfg, *, surface: str = "train",
+           dims: Optional[List[str]] = None,
+           budget: Optional[int] = None,
+           config: Mapping[str, Any] = ()) -> Dict[str, Any]:
+    """Run the search; returns the result document the registry
+    persists (winner + full scored-candidate table + space ledger).
+
+    Must run on the canonical compile mesh for the base topology (the
+    CLI re-execs itself there, like ``perf.budget``).
+    """
+    budget = search_budget(budget, dict(config) if config else None)
+    space: Space = enumerate_space(base_plan, model_cfg, surface=surface,
+                                  dims=dims, config=config)
+    chip = chip_for_plan(base_plan)
+    logger.info("autotune: %d candidate(s) after static pruning "
+                "(%d pruned; dims %s; budget %d compiles)",
+                len(space), len(space.pruned), space.dims, budget)
+
+    to_compile = list(space.candidates)
+    coarse_skipped = 0
+    if len(to_compile) > budget:
+        # successive halving, one deterministic rung: coarse-rank, keep
+        # the top `budget` (base always rides along)
+        ranked = sorted(
+            space.candidates,
+            key=lambda c: (coarse_score(c, model_cfg, chip=chip),
+                           candidate_sort_key(c, base_plan, surface)))
+        keep = ranked[:budget]
+        if space.base not in keep:
+            keep = [space.base] + keep[:budget - 1]
+        dropped = [c for c in space.candidates if c not in keep]
+        coarse_skipped = len(dropped)
+        for c in dropped:
+            _emit("autotune_candidate", fingerprint=c.fingerprint(),
+                  phase="coarse", env=c.env_dict() or None)
+        logger.info("autotune: coarse rung kept %d/%d candidates for "
+                    "full compile", len(keep), len(space.candidates))
+        # restore enumeration order for the compile rung (determinism)
+        to_compile = sorted(
+            keep, key=lambda c: candidate_sort_key(c, base_plan, surface))
+        to_compile = [space.base] + [c for c in to_compile
+                                     if c is not space.base]
+
+    memo: Dict = {}
+    table: List[Dict[str, Any]] = []
+    for cand in to_compile:
+        score, report = score_candidate(cand, model_cfg, surface=surface,
+                                        chip=chip, _memo=memo)
+        row = {
+            "fingerprint": cand.fingerprint(),
+            "plan_fingerprint": cand.plan.fingerprint(),
+            "compile_fingerprint": cand.plan.compile_fingerprint(surface),
+            "diff": _plan_diff(cand.plan, base_plan, surface),
+            "env": cand.env_dict() or None,
+            "distance": distance(cand.plan, base_plan, surface),
+            "score": score,
+            "report": report.summary(),
+        }
+        table.append(row)
+        _emit("autotune_candidate", fingerprint=row["fingerprint"],
+              phase="full", modeled_step_s=score["modeled_step_s"],
+              env=row["env"])
+        logger.info("autotune: %s modeled %.3es (%s-bound)%s",
+                    row["fingerprint"], score["modeled_step_s"],
+                    score["binding"],
+                    f" diff {row['diff']}" if row["diff"] else " [base]")
+
+    base_row = table[0]
+    # ranked by the surface's objective: step time on train (tokens
+    # constant across the space), per-token time on serve (max_batch
+    # varies — iteration latency alone would crown a smaller batch
+    # that serves fewer tokens per iteration)
+    ranked_rows = sorted(
+        table, key=lambda r: (rank_metric(r["score"], surface),
+                              r["distance"], r["fingerprint"]))
+    winner_row = ranked_rows[0]
+    winner_cand = next(c for c in to_compile
+                       if c.fingerprint() == winner_row["fingerprint"])
+    improvement = (rank_metric(base_row["score"], surface)
+                   / max(rank_metric(winner_row["score"], surface),
+                         1e-30))
+    result = {
+        "surface": surface,
+        "chip": chip.name,
+        "scorer_version": SCORER_VERSION,
+        "base": base_row,
+        "winner": winner_row,
+        "winner_tuned_fields": {
+            f: getattr(winner_cand.plan, f)
+            for f in TUNABLE_FIELDS[surface]},
+        "winner_env": winner_cand.env_dict(),
+        "improvement": improvement,
+        "candidates": ranked_rows,
+        "space": {
+            "enumerated": len(space) + len(space.pruned),
+            "statically_pruned": len(space.pruned),
+            "coarse_skipped": coarse_skipped,
+            "compiled": len({(c.plan.compile_fingerprint(surface), c.env)
+                             for c in to_compile}),
+            "scored": len(table),
+            "dims": space.dims,
+        },
+        "pruned": space.pruned,
+    }
+    _emit("autotune_result",
+          winner=winner_row["fingerprint"], base=base_row["fingerprint"],
+          winner_step_s=winner_row["score"]["modeled_step_s"],
+          base_step_s=base_row["score"]["modeled_step_s"],
+          improvement=improvement, candidates=len(table),
+          compiled=result["space"]["compiled"],
+          pruned=len(space.pruned))
+    logger.info(
+        "autotune: winner %s modeled %.3es vs base %.3es (%.3fx)%s",
+        winner_row["fingerprint"],
+        winner_row["score"]["modeled_step_s"],
+        base_row["score"]["modeled_step_s"], improvement,
+        f" diff {winner_row['diff']}" if winner_row["diff"]
+        else " — the hand-written default stands")
+    return result

@@ -55,6 +55,12 @@ PLAN_SCOPED_KEYS = frozenset({
     # compile-relevant (toggling telemetry must not stale a sidecar;
     # plan.COMPILE_SURFACES excludes them).
     "OBS", "OBS_DIR", "OBS_CAPTURE", "OBS_CAPTURE_BUDGET", "TRACE",
+    # autotuning (autotune/): AUTOTUNE=1 overlays a tuned-plan registry
+    # hit (keyed by model digest + topology + surface) onto the
+    # resolved plan before anything compiles. The flag itself is
+    # operational (consulting the registry must not stale a sidecar);
+    # the overlay re-fingerprints through the fields it changes.
+    "AUTOTUNE",
     # kernel & overlap execution path (ROADMAP #3): OVERLAP picks the
     # collective-hiding mode (off | xla | manual), FUSED_OPS routes the
     # memory-bound epilogues through the fused Pallas kernels. Both are
@@ -103,6 +109,13 @@ KNOWN_KEYS = frozenset({
     # Trainer-scoped (like SERVE_AFTER_TRAIN), not plan-scoped: they
     # change retry policy, never the compiled program.
     "ELASTIC", "MIN_DEVICES",
+    # autotune registry/search knobs (autotune/): AUTOTUNE_DIR points
+    # the tuned-plan registry somewhere other than <repo>/tuned_plans;
+    # AUTOTUNE_BUDGET caps the full-compile count the search spends
+    # (successive halving beyond it). Trainer/CLI-scoped like
+    # KERNELCHECK — neither changes the compiled program (the AUTOTUNE
+    # flag itself is plan-scoped above).
+    "AUTOTUNE_DIR", "AUTOTUNE_BUDGET",
     # kernelcheck (analysis/kernelcheck.py): KERNELCHECK=1 runs the
     # registry's differential startup probe in every worker (each
     # kernel's cheapest case vs its oracle, gated by the pinned

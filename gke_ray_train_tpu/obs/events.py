@@ -68,6 +68,13 @@ EVENT_KINDS: Dict[str, tuple] = {
     # serving (serve/engine.py / rayint/serving.py)
     "serve_start": ("replica", "executables"),
     "serve_drained": ("replica", "stats"),
+    # autotune search (autotune/search.py): one event per scored
+    # candidate (phase: coarse | full | pruned) + the final verdict
+    "autotune_candidate": ("fingerprint", "phase", "modeled_step_s",
+                           "env"),
+    "autotune_result": ("key", "winner", "base", "winner_step_s",
+                        "base_step_s", "improvement", "candidates",
+                        "compiled", "pruned"),
     # entry-script artifacts
     "export": ("path", "what"),
 }

@@ -263,6 +263,22 @@ def build_serve_preset_step(preset: Union[str, ServePreset], *,
     return compiled, params, state
 
 
+def preset_model_cfg(preset: Union[str, Preset, ServePreset]):
+    """The deterministic tiny ModelConfig a preset measures — the ONE
+    model shared by the budget compile, ``analysis check`` and the
+    autotune search (whose registry entries are keyed by this model's
+    digest, so a tuned plan provably describes the budget model)."""
+    from gke_ray_train_tpu.models import tiny
+    if isinstance(preset, ServePreset) or (
+            isinstance(preset, str) and preset in SERVE_PRESETS):
+        p = SERVE_PRESETS[preset] if isinstance(preset, str) else preset
+        return _serve_model_cfg(p)
+    p = PRESETS[preset] if isinstance(preset, str) else preset
+    return tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
+                d_ff=128, vocab_size=256, max_seq_len=p.seq,
+                remat=p.remat)
+
+
 def plan_for_preset(preset: Union[str, "Preset"]):
     """The ExecutionPlan a budget preset measures under — the SAME plan
     object ``analysis check`` and the budget CLI consume, so one
@@ -318,7 +334,6 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
     import jax
     import jax.numpy as jnp
 
-    from gke_ray_train_tpu.models import tiny
     from gke_ray_train_tpu.train import (
         make_optimizer, make_train_state, make_train_step)
 
@@ -327,9 +342,9 @@ def build_preset_step(preset: Union[str, Preset], *, remat=None,
     # same plan object whose fingerprint the budget JSON records
     plan = _dc.replace(plan_for_preset(p), donate_state=donate)
     mesh = plan.build_mesh(jax.devices())
-    cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2, d_ff=128,
-               vocab_size=256, max_seq_len=p.seq,
-               remat=p.remat if remat is None else remat)
+    cfg = preset_model_cfg(p)
+    if remat is not None:
+        cfg = _dc.replace(cfg, remat=remat)
     opt = make_optimizer(1e-3)
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
     # donate_state=False default: budgets must not vary with backend
@@ -392,12 +407,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("names", nargs="*",
                         help=f"presets (default: all of "
                              f"{all_preset_names()})")
+    parser.add_argument("--all", action="store_true", dest="sweep_all",
+                        help="sweep EVERY checked-in preset (train + "
+                             "hybrid + serve) in one invocation — the "
+                             "explicit spelling record_baselines.sh and "
+                             "the CI budget step use, so the gate can "
+                             "never silently narrow to a hand-kept "
+                             "preset list")
     parser.add_argument("--dir", default=BUDGET_DIR,
                         help="budget directory (default tests/budgets)")
     args = parser.parse_args(argv)
+    if args.sweep_all and args.names:
+        parser.error("--all and explicit preset names are mutually "
+                     "exclusive")
     if os.environ.get("_BUDGET_CLI_NATIVE") != "1":
         return _reexec_on_cpu_mesh(
-            [args.command] + args.names + ["--dir", args.dir])
+            [args.command] + args.names
+            + (["--all"] if args.sweep_all else [])
+            + ["--dir", args.dir])
 
     import jax
     assert jax.devices()[0].platform == "cpu" and len(jax.devices()) == 8, \

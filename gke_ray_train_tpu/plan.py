@@ -211,6 +211,16 @@ class ExecutionPlan:
     # both); operational like every obs knob — never compile-relevant.
     trace: bool = True
 
+    # -- autotuning (autotune/) -----------------------------------------
+    # AUTOTUNE=1 opts the run into overlaying a tuned-plan registry hit
+    # (autotune/registry.py, keyed by model-config digest + topology +
+    # surface) onto the resolved plan before anything compiles. The
+    # FLAG is operational — whether we consulted the registry must not
+    # stale a sidecar; the OVERLAY changes compile-relevant fields and
+    # re-fingerprints the plan through them, exactly like spelling the
+    # tuned values by hand. Excluded from COMPILE_SURFACES like OBS.
+    autotune: bool = False
+
     # -- overlap / fused-kernel execution path (ROADMAP #3) -------------
     # communication/compute overlap mode for the train step:
     #   off    — the plain GSPMD scan (collectives where GSPMD put them)
@@ -700,6 +710,7 @@ CONFIG_KEYS: Dict[str, str] = {
     "obs_capture": "OBS_CAPTURE",
     "obs_capture_budget": "OBS_CAPTURE_BUDGET",
     "trace": "TRACE",
+    "autotune": "AUTOTUNE",
     "overlap": "OVERLAP",
     "fused_ops": "FUSED_OPS",
     "dcn_sync": "DCN_SYNC",
@@ -788,6 +799,21 @@ def replan(plan: ExecutionPlan, n_devices: int, *, model_cfg=None,
 
     if n_devices < 1:
         raise PlanError(f"replan: n_devices={n_devices} must be >= 1")
+    # a tuned-plan overlay (autotune/registry.py) is keyed by the
+    # topology it was searched on — a plan tuned for 8 devices silently
+    # riding a 4-device attempt is a correctness trap. Drop it the same
+    # way the stale BUDGET_PRESET pin is dropped below: replan from the
+    # PRE-overlay plan, and let the caller's maybe_apply re-key the
+    # registry lookup against the survivors' topology (usually a miss).
+    tuned_base = getattr(plan, "_tuned_base", None)
+    if tuned_base is not None and n_devices != plan.chips:
+        import logging
+        logging.getLogger(__name__).warning(
+            "replan: dropping tuned-plan overlay %s (tuned for %s; "
+            "pool is %d devices) — the registry re-keys on the new "
+            "topology", getattr(plan, "_tuned_key", "<unkeyed>"),
+            plan.topology, n_devices)
+        plan = tuned_base
     try:
         base = plan.resolved_sizes()
     except ValueError as e:
@@ -854,12 +880,15 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
         # a driver-side `env OVERLAP=manual` / `FUSED_OPS=1` A/B must
         # shape the program every worker compiles — and so must the
         # DCN gradient-sync arms (`env DCN_SYNC=hier DCN_COMPRESS=bf16`)
-        "overlap", "fused_ops", "dcn_sync", "dcn_compress")))
+        "overlap", "fused_ops", "dcn_sync", "dcn_compress",
+        # a driver-side `env AUTOTUNE=1` must reach every worker's
+        # registry lookup (autotune/registry.py)
+        "autotune")))
 
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
                           "divergence_guard", "obs", "obs_capture",
-                          "trace", "fused_ops"})
+                          "trace", "fused_ops", "autotune"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",

@@ -224,6 +224,13 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
     # (the entry/worker fn does the same via rayint/elastic.py), so the
     # logged identity — and the compile-cache namespace — match what
     # the attempt actually compiles.
+    # snapshot the tuned-overlay env keys BEFORE maybe_apply below can
+    # export an entry's flash blocks — the finally must restore the
+    # PRE-attempt values, or a dropped overlay's env leaks into a later
+    # in-process attempt that runs untuned (attempt-scoped, like the
+    # KERNELCHECK export further down)
+    from gke_ray_train_tpu.autotune.space import ENV_OVERRIDE_KEYS
+    prev_overrides = {k: os.environ.get(k) for k in ENV_OVERRIDE_KEYS}
     plan = None
     try:
         plan = ExecutionPlan.resolve(config)
@@ -239,6 +246,15 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
         if pool_n and pool_n != plan.chips:
             from gke_ray_train_tpu.plan import replan
             plan = replan(plan, pool_n)
+        # tuned-plan overlay (autotune/registry.py): AFTER the replan,
+        # so the registry lookup keys on the topology this attempt
+        # actually runs — a reshard re-keys (usually a miss) instead of
+        # a stale 8-device tune riding a 4-device attempt. Loud apply,
+        # loud refusal; the cache enable below then namespaces by the
+        # TUNED plan's compile fingerprint.
+        if plan.autotune:
+            from gke_ray_train_tpu.autotune.registry import maybe_apply
+            plan, _ = maybe_apply(plan, config=config, log=logger)
         logger.info("execution plan %s (topology %s)",
                     plan.fingerprint(), plan.topology)
     except PlanError as e:
@@ -332,6 +348,11 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
                 os.environ.pop("KERNELCHECK", None)
             else:
                 os.environ["KERNELCHECK"] = prev_kernelcheck
+        for k, prev in prev_overrides.items():
+            if prev is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = prev
 
 
 class JaxTrainer:
@@ -613,11 +634,14 @@ class JaxTrainer:
             from gke_ray_train_tpu.plan import ENV_FORWARD_KEYS
             env_base.update({k: os.environ[k] for k in ENV_FORWARD_KEYS
                              if k in os.environ})
-            # elastic knobs + the per-attempt pool override ride to the
-            # workers the same way (rayint/elastic.py)
+            # elastic + autotune-registry knobs + the per-attempt pool
+            # override ride to the workers the same way (AUTOTUNE
+            # itself is plan-scoped and already in ENV_FORWARD_KEYS;
+            # the registry DIR is operational like KERNELCHECK)
             env_base.update({k: os.environ[k]
                              for k in ("ELASTIC", "MIN_DEVICES",
-                                       "NUM_SLICES", "KERNELCHECK")
+                                       "NUM_SLICES", "KERNELCHECK",
+                                       "AUTOTUNE_DIR")
                              if k in os.environ})
             env_base.update(self._pool_env())
             futures = [

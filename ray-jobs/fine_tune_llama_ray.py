@@ -84,6 +84,13 @@ def train_loop_per_worker(config: dict):
     # compile fingerprint, which must be the survivors'.
     from gke_ray_train_tpu.rayint.elastic import maybe_replan
     plan, devices = maybe_replan(plan, config=config, log=logger)
+    # tuned-plan overlay (autotune/registry.py): with AUTOTUNE=1,
+    # overlay the registry hit keyed by (model digest, topology,
+    # surface) onto the resolved plan — AFTER the replan so a reshard
+    # re-keys the lookup, BEFORE the cache/mesh so everything compiles
+    # the tuned program. Loud apply, loud refusal on drift.
+    from gke_ray_train_tpu.autotune.registry import maybe_apply
+    plan, _ = maybe_apply(plan, config=config, log=logger)
     # persistent XLA compile cache (perf/cache.py): restarts and peer
     # hosts reuse the compiled binary; re-enabled post-init so the
     # cache dir carries the real device-topology fingerprint

@@ -72,6 +72,13 @@ def train_loop_per_worker(config: dict):
     # by the plan's compile fingerprint, which must be the survivors'.
     from gke_ray_train_tpu.rayint.elastic import maybe_replan
     plan, devices = maybe_replan(plan, config=config, log=logger)
+    # tuned-plan overlay (autotune/registry.py): AUTOTUNE=1 overlays a
+    # registry hit AFTER the replan (the lookup keys on the attempt's
+    # real topology) and BEFORE the cache/mesh. This entry's model is
+    # data-derived (tokenizer vocab), so the static model-digest lookup
+    # usually misses — the hook logs that loudly rather than guessing.
+    from gke_ray_train_tpu.autotune.registry import maybe_apply
+    plan, _ = maybe_apply(plan, config=config, log=logger)
     # persistent XLA compile cache on the shared PVC: the first worker
     # to compile pays; every restart (and every other host) reuses the
     # binary. Re-enabled here (the trainer already enabled it pre-init)

@@ -54,6 +54,14 @@ run overlap        env BENCH_MODE=overlap python bench.py
 # DCN traffic shrink factor (~= ici_size)
 run dcn            env BENCH_MODE=dcn python bench.py
 
+# autotune default-vs-tuned A/B (autotune/, re-execs onto the canonical
+# 8-fake-device CPU mesh): cost-model search over the tiny_fsdp8 base
+# plan; the record carries the winner diff, per-arm StepCostReport +
+# exposed bytes + plan fingerprints, and both arms' real loss streams
+# (tuned trajectory asserted valid against the default's shape);
+# value = modeled step-time improvement
+run autotune       env BENCH_MODE=autotune python bench.py
+
 # fault-tolerance drill: time-to-recover (injected kill -> first
 # post-resume step) + checkpoint-save latency under SIGTERM (must fit
 # the preemption grace window); the record splits recompile time from
@@ -95,9 +103,11 @@ run obs-diff       python -m gke_ray_train_tpu.obs diff "$OBS_ELASTIC_DIR" \
 
 # compile-cost budgets (tests/budgets/*.json) are recorded on the
 # canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI
-# re-execs itself there; `check` is what tier-1 runs. Only re-record
+# re-execs itself there; `check` is what tier-1 runs. `--all` sweeps
+# EVERY checked-in preset (train + hybrid + serve) in one invocation —
+# never enumerate presets by hand here. Only re-record (`record --all`)
 # after an INTENTIONAL cost change, and review the JSON diff like code.
-run budget-check   python -m gke_ray_train_tpu.perf.budget check
+run budget-check   python -m gke_ray_train_tpu.perf.budget check --all
 
 # shardlint (gke_ray_train_tpu/analysis): the AST pass over the repo
 # plus the trace-level analyzers on the canonical CPU mesh — no

@@ -1,0 +1,467 @@
+"""autotune/ — cost-model-driven plan search + tuned-plan registry.
+
+Contracts drilled here:
+
+- the AUTOTUNE plan knob: 3-dialect coercion, compile-fingerprint
+  invariance (consulting the registry must not stale a sidecar), env
+  forwarding;
+- property-style enumerator coverage: EVERY candidate space.py yields
+  passes ExecutionPlan validation, plancheck feasibility and
+  kernelcheck statics with NO compile, preserves the global batch, and
+  never reflows a structural axis;
+- determinism: two enumerations are identical; two full searches over
+  the same space produce a bitwise-identical winner + candidate table;
+- the registry: save → load → validate → overlay roundtrip, loud
+  refusal on fingerprint-input drift or a tuned plan that no longer
+  validates, AUTOTUNE=1 runtime application via maybe_apply;
+- replan × tuning: an elastic reshard drops the overlay and re-keys
+  the lookup (the 8-device-tune-on-4-devices trap), regression-tested
+  from the plan side here and from the elastic side in test_elastic.py;
+- the tuned plan runs: a real step stream under the tuned plan compiles
+  exactly once (RECOMPILE_LIMIT=1 armed — zero recompiles beyond the
+  tuned plan's own compile).
+"""
+
+import dataclasses
+import json
+import os
+
+import pytest
+
+from gke_ray_train_tpu.autotune.space import (
+    TUNABLE_FIELDS, enumerate_space)
+from gke_ray_train_tpu.perf.budget import (
+    plan_for_preset, preset_model_cfg)
+from gke_ray_train_tpu.plan import ExecutionPlan, replan
+
+
+# ---------------------------------------------------------------------------
+# the AUTOTUNE plan knob
+# ---------------------------------------------------------------------------
+
+def test_autotune_knob_three_dialects_and_fingerprints():
+    from_json = ExecutionPlan.from_config({"AUTOTUNE": True})
+    from_env = ExecutionPlan.from_env({"AUTOTUNE": "1"})
+    from_kwargs = ExecutionPlan.from_kwargs(autotune=True)
+    assert from_json.autotune and from_env.autotune and from_kwargs.autotune
+    assert from_json.fingerprint() == from_env.fingerprint() \
+        == from_kwargs.fingerprint()
+    base = ExecutionPlan()
+    # operational: the flag changes the plan identity but NEVER the
+    # compiled-program identity on either surface
+    assert from_json.fingerprint() != base.fingerprint()
+    for surface in ("train", "serve", "all"):
+        assert from_json.compile_fingerprint(surface) \
+            == base.compile_fingerprint(surface)
+
+
+def test_autotune_env_forwarded_to_workers():
+    from gke_ray_train_tpu.plan import ENV_FORWARD_KEYS
+    assert "AUTOTUNE" in ENV_FORWARD_KEYS
+
+
+# ---------------------------------------------------------------------------
+# property-style enumerator coverage (no compile anywhere)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("preset", ["tiny_fsdp8", "tiny_dp8",
+                                    "tiny_hybrid_2x4_hier"])
+def test_every_train_candidate_statically_valid(preset):
+    from gke_ray_train_tpu.analysis.kernelcheck import (
+        kernel_constraint_findings)
+    base = plan_for_preset(preset)
+    cfg = preset_model_cfg(preset)
+    space = enumerate_space(base, cfg)
+    assert len(space) > 1
+    sizes0 = base.resolved_sizes()
+    for cand in space.candidates:
+        plan = cand.plan
+        # PLAN000 held by construction; PLAN001/002 clean:
+        assert plan.feasibility(cfg) == [], cand
+        # KER001-003 clean:
+        assert kernel_constraint_findings(plan, cfg) == [], cand
+        # global batch preserved, structural axes never reflowed
+        assert plan.global_batch() == base.global_batch(), cand
+        sizes = plan.resolved_sizes()
+        for axis in ("model", "context", "pipe"):
+            assert sizes[axis] == sizes0[axis], cand
+        if base.num_slices > 1:
+            assert sizes["data"] % base.num_slices == 0, cand
+
+
+def test_every_serve_candidate_statically_valid():
+    base = plan_for_preset("serve_tiny8")
+    cfg = preset_model_cfg("serve_tiny8")
+    space = enumerate_space(base, cfg, surface="serve")
+    assert len(space) > 1 and not space.pruned
+    for cand in space.candidates:
+        assert cand.plan.bucket_list()           # validates
+        assert cand.plan.max_batch >= 1
+        # the train surface's fields are untouched on serve candidates
+        for f in TUNABLE_FIELDS["train"]:
+            assert getattr(cand.plan, f) == getattr(base, f), cand
+
+
+def test_enumeration_deterministic_and_deduped():
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    a = [c.fingerprint() for c in enumerate_space(base, cfg).candidates]
+    b = [c.fingerprint() for c in enumerate_space(base, cfg).candidates]
+    assert a == b
+    assert len(a) == len(set(a))
+    # base plan is always candidate 0
+    assert a[0] == base.fingerprint()
+
+
+def test_dims_filter_and_unknown_dim():
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    full = enumerate_space(base, cfg)
+    mesh_only = enumerate_space(base, cfg, dims=["mesh"])
+    assert 1 < len(mesh_only) < len(full)
+    for cand in mesh_only.candidates:
+        assert cand.plan.overlap == base.overlap
+        assert cand.plan.fused_ops == base.fused_ops
+    with pytest.raises(ValueError, match="unknown autotune dims"):
+        enumerate_space(base, cfg, dims=["warp-drive"])
+
+
+# ---------------------------------------------------------------------------
+# search: bitwise determinism + the winner contract (compiles a small
+# mesh-only space on the fake-8 mesh)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def search_result():
+    from gke_ray_train_tpu.autotune.search import search
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    return search(base, cfg, dims=["mesh"])
+
+
+@pytest.mark.slow
+def test_search_winner_never_loses_to_base(search_result):
+    r = search_result
+    assert r["winner"]["score"]["modeled_step_s"] \
+        <= r["base"]["score"]["modeled_step_s"]
+    assert r["improvement"] >= 1.0
+    # full per-ceiling breakdown retained as provenance on every row
+    for row in r["candidates"]:
+        for key in ("t_compute_s", "t_hbm_s", "t_ici_s", "t_dcn_s",
+                    "exposed_penalty_s", "binding", "modeled_step_s",
+                    "mfu_ceiling", "chip"):
+            assert key in row["score"], (row["fingerprint"], key)
+    # the table is sorted best-first and contains the base row
+    steps = [row["score"]["modeled_step_s"] for row in r["candidates"]]
+    assert steps == sorted(steps)
+    assert any(row["fingerprint"] == r["base"]["fingerprint"]
+               for row in r["candidates"])
+
+
+@pytest.mark.slow
+def test_search_bitwise_deterministic(search_result):
+    from gke_ray_train_tpu.autotune.search import search
+    again = search(plan_for_preset("tiny_fsdp8"),
+                   preset_model_cfg("tiny_fsdp8"), dims=["mesh"])
+    assert json.dumps(again, sort_keys=True) \
+        == json.dumps(search_result, sort_keys=True)
+
+
+@pytest.mark.slow
+def test_search_emits_schema_valid_obs_events(monkeypatch):
+    from gke_ray_train_tpu.autotune.search import search
+    from gke_ray_train_tpu.obs import runtime as obs_runtime
+    from gke_ray_train_tpu.obs.events import validate_event
+    emitted = []
+
+    def fake_emit(kind, step=None, **payload):
+        validate_event(kind, payload)      # schema teeth at the source
+        emitted.append((kind, payload))
+
+    monkeypatch.setattr(obs_runtime, "emit", fake_emit)
+    # prefetch-only space: >1 candidates, ONE compile (memoized — the
+    # depths share a compile fingerprint), so the event contract is
+    # drilled without paying another mesh sweep
+    result = search(plan_for_preset("tiny_fsdp8"),
+                    preset_model_cfg("tiny_fsdp8"), dims=["prefetch"])
+    kinds = [k for k, _ in emitted]
+    assert kinds.count("autotune_result") == 1
+    assert kinds.count("autotune_candidate") == result["space"]["scored"]
+
+
+# ---------------------------------------------------------------------------
+# registry: roundtrip, refusal, runtime overlay
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_registry_roundtrip_and_maybe_apply(search_result, tmp_path,
+                                            monkeypatch):
+    from gke_ray_train_tpu.autotune import registry
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    path = registry.save_entry(search_result, base_plan=base,
+                               model_cfg=cfg, directory=str(tmp_path))
+    assert os.path.exists(path)
+    key = registry.entry_key(registry.model_digest(cfg), base.topology,
+                             "train")
+    entry = registry.load_entry(key, str(tmp_path))
+    assert entry is not None
+    assert registry.validate_entry(entry, base, cfg) == []
+    # the candidate table is persisted beside the entry
+    with open(os.path.join(str(tmp_path), entry["candidates_file"])) as f:
+        table = json.load(f)["candidates"]
+    assert len(table) == search_result["space"]["scored"]
+
+    # runtime overlay: AUTOTUNE=1 + AUTOTUNE_DIR → applied loudly
+    monkeypatch.setenv("AUTOTUNE_DIR", str(tmp_path))
+    armed = dataclasses.replace(base, autotune=True)
+    tuned, applied = registry.maybe_apply(armed, model_cfg=cfg)
+    assert applied
+    for f in TUNABLE_FIELDS["train"]:
+        assert getattr(tuned, f) == search_result["winner_tuned_fields"][f]
+    assert tuned.autotune
+    assert getattr(tuned, "_tuned_base") is armed
+    assert getattr(tuned, "_tuned_key") == key
+    # the winner's compiled program is what the run will fingerprint
+    assert tuned.compile_fingerprint("train") \
+        == search_result["winner"]["compile_fingerprint"]
+    # opt-out plans are untouched
+    same, applied = registry.maybe_apply(base, model_cfg=cfg)
+    assert same is base and not applied
+
+
+@pytest.mark.slow
+def test_registry_refuses_on_drift(search_result, tmp_path):
+    from gke_ray_train_tpu.autotune import registry
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    registry.save_entry(search_result, base_plan=base, model_cfg=cfg,
+                        directory=str(tmp_path))
+    key = registry.entry_key(registry.model_digest(cfg), base.topology,
+                             "train")
+    entry = registry.load_entry(key, str(tmp_path))
+
+    # model drift: the digest no longer matches the run's model
+    other = dataclasses.replace(cfg, d_ff=cfg.d_ff * 2)
+    assert any("model digest" in m
+               for m in registry.validate_entry(entry, base, other))
+    # scorer drift
+    doctored = dict(entry, fingerprint_inputs=dict(
+        entry["fingerprint_inputs"], scorer_version=-1))
+    assert any("scorer version" in m
+               for m in registry.validate_entry(doctored, base, cfg))
+    # topology drift
+    moved = dataclasses.replace(base, topology="cpu-4", fsdp=4)
+    assert any("topology" in m
+               for m in registry.validate_entry(entry, moved, cfg))
+    # a tuned plan that no longer validates (data=3 cannot tile 8)
+    broken = dict(entry, tuned=dict(entry["tuned"], data=3, fsdp=2))
+    assert registry.validate_entry(broken, base, cfg) != []
+    # a run whose configured batch differs from the entry's base: the
+    # overlay would silently move the global batch — refused
+    bigger = dataclasses.replace(base, per_device_batch=4)
+    assert any("does not preserve this run's configured product" in m
+               for m in registry.validate_entry(entry, bigger, cfg))
+
+    # and maybe_apply REFUSES (continues untuned) instead of crashing
+    armed = dataclasses.replace(base, autotune=True)
+    plan, applied = registry.maybe_apply(
+        armed, model_cfg=other, config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert plan is armed and not applied
+
+
+def test_maybe_apply_miss_and_underivable_model(tmp_path):
+    from gke_ray_train_tpu.autotune import registry
+    armed = dataclasses.replace(plan_for_preset("tiny_fsdp8"),
+                                autotune=True)
+    # empty registry → loud miss, untuned
+    plan, applied = registry.maybe_apply(
+        armed, model_cfg=preset_model_cfg("tiny_fsdp8"),
+        config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert plan is armed and not applied
+    # no statically-derivable model (no MODEL_ID / SMOKE_TEST) → untuned
+    plan, applied = registry.maybe_apply(
+        armed, config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert plan is armed and not applied
+
+
+@pytest.mark.slow
+def test_maybe_apply_derives_model_from_smoke_config(tmp_path):
+    """The _run_worker path end to end: the search runs on the model a
+    SMOKE_TEST config statically resolves to, the entry is keyed by
+    that model's digest, and a worker whose config says AUTOTUNE=1
+    derives the same digest and overlays — with no model object passed
+    in anywhere."""
+    from gke_ray_train_tpu.analysis.plancheck import model_config_for
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.autotune.search import search
+    base = plan_for_preset("tiny_fsdp8")
+    config = {**{k: v for k, v in base.to_config().items()
+                 if v is not None},
+              "SMOKE_TEST": 1, "AUTOTUNE": 1,
+              "AUTOTUNE_DIR": str(tmp_path)}
+    plan = ExecutionPlan.from_config(config)
+    smoke_cfg = model_config_for(config, plan)
+    result = search(plan, smoke_cfg, dims=["mesh"])
+    registry.save_entry(result, base_plan=plan, model_cfg=smoke_cfg,
+                        directory=str(tmp_path))
+    tuned, applied = registry.maybe_apply(plan, config=config)
+    assert applied
+    assert tuned.data == result["winner_tuned_fields"]["data"]
+    assert tuned.fsdp == result["winner_tuned_fields"]["fsdp"]
+
+
+# ---------------------------------------------------------------------------
+# replan x tuning: the reshard drops the overlay and re-keys
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_replan_drops_tuned_overlay(search_result, tmp_path):
+    from gke_ray_train_tpu.autotune import registry
+    base = dataclasses.replace(plan_for_preset("tiny_fsdp8"),
+                               autotune=True)
+    cfg = preset_model_cfg("tiny_fsdp8")
+    registry.save_entry(search_result, base_plan=base, model_cfg=cfg,
+                        directory=str(tmp_path))
+    tuned, applied = registry.maybe_apply(
+        base, model_cfg=cfg, config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert applied
+    # reshard to 4 devices: the overlay is DROPPED — the result is
+    # exactly what replanning the never-tuned plan gives, and carries
+    # no overlay marker for a later attempt to trip over
+    shrunk = replan(tuned, 4, model_cfg=cfg)
+    assert shrunk.fingerprint() == replan(base, 4,
+                                          model_cfg=cfg).fingerprint()
+    assert getattr(shrunk, "_tuned_base", None) is None
+    # ...and the re-keyed lookup on the survivors' topology misses (no
+    # cpu-4 entry recorded), so the attempt runs untuned — loudly
+    plan, applied = registry.maybe_apply(
+        shrunk, model_cfg=cfg, config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert plan is shrunk and not applied
+    # identity replan (pool unchanged) keeps the overlay
+    assert replan(tuned, tuned.chips) is tuned
+
+
+# ---------------------------------------------------------------------------
+# the tuned plan actually runs: one compile, zero recompiles
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_tuned_plan_trains_with_zero_recompiles(search_result, devices):
+    import jax
+    import jax.numpy as jnp
+
+    from gke_ray_train_tpu.analysis.guards import (
+        install_recompile_limit, uninstall_recompile_limit)
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    tuned = dataclasses.replace(base, **{
+        f: search_result["winner_tuned_fields"][f]
+        for f in TUNABLE_FIELDS["train"]})
+    mesh = tuned.build_mesh(devices)
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+    step = make_train_step(cfg, opt, mesh=mesh, plan=tuned)
+    rows, seq = tuned.global_batch(), tuned.max_seq_len
+    batch = jax.device_put(
+        {"inputs": jnp.zeros((rows, seq), jnp.int32),
+         "targets": jnp.zeros((rows, seq), jnp.int32),
+         "weights": jnp.ones((rows, seq), jnp.float32)},
+        tuned.batch_shardings(mesh))
+    assert install_recompile_limit(limit=1)
+    try:
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+    finally:
+        uninstall_recompile_limit()
+    assert all(v == v for v in losses)       # finite stream, one compile
+
+
+# ---------------------------------------------------------------------------
+# CLI contracts (in-process; apply/explain are static)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_entry_roundtrips_and_applies(tmp_path):
+    """The serve half of the registry is actually applicable: a
+    freshly-recorded serve entry validates clean (the mesh arithmetic
+    a mesh-local decode plan can never satisfy is skipped on the serve
+    surface, exactly as the enumerator skips it) and overlays."""
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.autotune.search import search
+    base = plan_for_preset("serve_tiny8")
+    cfg = preset_model_cfg("serve_tiny8")
+    result = search(base, cfg, surface="serve")
+    registry.save_entry(result, base_plan=base, model_cfg=cfg,
+                        directory=str(tmp_path))
+    key = registry.entry_key(registry.model_digest(cfg), base.topology,
+                             "serve")
+    entry = registry.load_entry(key, str(tmp_path))
+    assert registry.validate_entry(entry, base, cfg) == []
+    armed = dataclasses.replace(base, autotune=True)
+    tuned, applied = registry.maybe_apply(
+        armed, model_cfg=cfg, surface="serve",
+        config={"AUTOTUNE_DIR": str(tmp_path)})
+    assert applied
+    for f in TUNABLE_FIELDS["serve"]:
+        assert getattr(tuned, f) == result["winner_tuned_fields"][f]
+
+
+@pytest.mark.slow
+def test_entry_with_stray_env_refused(search_result, tmp_path):
+    """A corrupt/doctored entry cannot export arbitrary env into a
+    worker: only ENV_OVERRIDE_KEYS pass validation."""
+    from gke_ray_train_tpu.autotune import registry
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    registry.save_entry(search_result, base_plan=base, model_cfg=cfg,
+                        directory=str(tmp_path))
+    key = registry.entry_key(registry.model_digest(cfg), base.topology,
+                             "train")
+    entry = registry.load_entry(key, str(tmp_path))
+    doctored = dict(entry, env={"LD_PRELOAD": "/tmp/evil.so"})
+    assert any("undeclared env overrides" in m
+               for m in registry.validate_entry(doctored, base, cfg))
+
+
+def test_cli_refuses_big_models():
+    from gke_ray_train_tpu.autotune.__main__ import _guard_model_size
+    from gke_ray_train_tpu.models import llama3_8b
+    with pytest.raises(SystemExit, match="refusing to compile-score"):
+        _guard_model_size(ExecutionPlan.from_kwargs(topology="v5e-16",
+                                                    data=1, fsdp=16),
+                          llama3_8b())
+
+
+def test_cli_explain_rc_contract(tmp_path):
+    from gke_ray_train_tpu.autotune.__main__ import main
+    assert main(["explain", "--dir", str(tmp_path)]) == 3
+    assert main(["apply", "--dir", str(tmp_path)]) == 3
+
+
+@pytest.mark.slow
+def test_cli_apply_and_explain_after_search(search_result, tmp_path,
+                                            capsys):
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.autotune.__main__ import main
+    registry.save_entry(search_result,
+                        base_plan=plan_for_preset("tiny_fsdp8"),
+                        model_cfg=preset_model_cfg("tiny_fsdp8"),
+                        directory=str(tmp_path))
+    assert main(["apply", "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "applied train-cpu-8-" in out
+    assert main(["explain", "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "candidate table" in out and "fingerprint inputs" in out
+
+
+def test_budget_cli_all_excludes_names():
+    from gke_ray_train_tpu.perf.budget import main
+    with pytest.raises(SystemExit) as e:
+        main(["check", "tiny_fsdp8", "--all"])
+    assert e.value.code == 2

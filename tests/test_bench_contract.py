@@ -67,6 +67,50 @@ def test_bench_dcn_mode_registered():
         assert f'"{field}"' in src, field
 
 
+def test_bench_autotune_mode_registered():
+    """BENCH_MODE=autotune is in the dispatch registry and its record
+    pins the default-vs-tuned schema (the fast half; the slow half
+    runs the subprocess)."""
+    src = open(os.path.join(REPO, "bench.py")).read()
+    assert '"autotune": bench_autotune' in src
+    for field in ("modeled_step_s_default", "modeled_step_s_tuned",
+                  "winner_diff", "plan_fingerprint_default",
+                  "plan_fingerprint_tuned",
+                  "exposed_collective_bytes_default",
+                  "exposed_collective_bytes_tuned",
+                  "cost_report_default", "cost_report_tuned",
+                  "loss_stream_default", "loss_stream_tuned",
+                  "loss_trajectory_valid"):
+        assert f'"{field}"' in src, field
+
+
+@pytest.mark.slow
+def test_bench_autotune_record_shape():
+    """BENCH_MODE=autotune emits ONE valid record: the winner never
+    loses to the default (it is candidate 0 of its own space), both
+    arms' cost evidence rides the record, and the tuned arm's real
+    loss stream validates against the default trajectory."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
+    env.update(BENCH_MODE="autotune", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO, COMPILE_CACHE="0")
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=600, env=env)
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["unit"] == "x" and rec["value"] >= 1.0
+    assert rec["modeled_step_s_tuned"] <= rec["modeled_step_s_default"]
+    assert rec["loss_trajectory_valid"] is True
+    assert all(v == v for v in rec["loss_stream_tuned"])
+    assert rec["plan_fingerprint_default"] \
+        and rec["plan_fingerprint_tuned"]
+    assert rec["cost_report_default"]["collective_bytes"] >= 0
+    assert rec["space"]["scored"] >= rec["space"]["compiled"] >= 2
+
+
 @pytest.mark.slow
 def test_bench_dcn_record_shape():
     """BENCH_MODE=dcn emits ONE valid record: bitwise flat-vs-hier

@@ -106,6 +106,38 @@ def test_replan_repins_topology_and_drops_stale_budget():
     assert odd.topology == "cpu-6" and odd.chips == 6
 
 
+def test_replan_drops_tuned_plan_overlay():
+    """replan x tuning (ISSUE 15): a tuned-plan overlay is keyed by the
+    topology it was searched on — an elastic reshard must DROP it the
+    same way it drops a stale BUDGET_PRESET pin. A plan tuned for 8
+    devices silently riding a 4-device attempt is a correctness trap:
+    the overlay's mesh/batch/sync choices were scored on a program the
+    survivors will never compile."""
+    from gke_ray_train_tpu.autotune.registry import apply_entry
+    base = ExecutionPlan.from_kwargs(
+        data=2, fsdp=4, per_device_batch=1, max_seq_len=64,
+        donate_state=False, donate_batch=False, topology="cpu-8",
+        autotune=True)
+    entry = {"surface": "train", "key": "train-cpu-8-deadbeefdeadbeef",
+             "tuned": {"data": 1, "fsdp": 8, "overlap": "off",
+                       "fused_ops": True}}
+    tuned = apply_entry(base, entry)
+    assert tuned.fsdp == 8 and tuned.fused_ops
+    assert getattr(tuned, "_tuned_base") is base
+    shrunk = replan(tuned, 4)
+    # the reshard result is EXACTLY what replanning the never-tuned
+    # plan gives — no tuned field rides along...
+    assert shrunk.fingerprint() == replan(base, 4).fingerprint()
+    assert not shrunk.fused_ops and shrunk.overlap == base.overlap
+    # ...and no stale overlay marker survives for a later attempt
+    assert getattr(shrunk, "_tuned_base", None) is None
+    # the AUTOTUNE opt-in itself survives (the next attempt re-keys
+    # the registry lookup against cpu-4 — usually a miss)
+    assert shrunk.autotune and shrunk.topology == "cpu-4"
+    # identity replan (pool unchanged) keeps the applied overlay
+    assert replan(tuned, tuned.chips) is tuned
+
+
 def test_replan_shrinks_slices_proportionally():
     plan = ExecutionPlan.from_kwargs(data=4, fsdp=2, num_slices=2,
                                      topology="cpu-8")
