@@ -1332,10 +1332,10 @@ def bench_autotune():
             losses.append(m["loss"])
         losses = [float(v) for v in jax.device_get(losses)]
         dt = max(time.perf_counter() - t0, 1e-9)
-        return losses, steps * B * S / dt / len(devices)
+        return losses, steps * B * S / dt / len(devices), dt / steps
 
-    loss_default, tps_default = run_arm(base)
-    loss_tuned, tps_tuned = run_arm(tuned)
+    loss_default, tps_default, step_s_default = run_arm(base)
+    loss_tuned, tps_tuned, step_s_tuned = run_arm(tuned)
     # trajectory-shape assertion: finite, decreasing like the default,
     # and pointwise within 5% of the default stream (the arms share
     # init, data and global batch; only the partitioning differs)
@@ -1362,6 +1362,14 @@ def bench_autotune():
          "winner_diff": result["winner"]["diff"],
          "plan_fingerprint_default": result["base"]["plan_fingerprint"],
          "plan_fingerprint_tuned": result["winner"]["plan_fingerprint"],
+         # the MEASURED half of the calibration loop (obs/observe.py
+         # reads these per-arm fields back out of the obs-dir copy of
+         # this record; `autotune ingest` turns them into observed
+         # registry rows keyed by the per-arm fingerprints above)
+         "measured_step_s_default": round(step_s_default, 6),
+         "measured_step_s_tuned": round(step_s_tuned, 6),
+         "steps": steps,
+         "topology": base.topology,
          "exposed_collective_bytes_default":
              result["base"]["report"]["exposed_collective_bytes"],
          "exposed_collective_bytes_tuned":

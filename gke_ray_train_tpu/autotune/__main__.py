@@ -16,8 +16,22 @@
              the tuned plan's flat-config dialect + fingerprints.
              rc 0 applied · 3 no entry · 4 refused (stale/invalid).
 ``explain``  print a recorded entry's provenance: key, fingerprint
-             inputs, score breakdown, improvement, top of the candidate
-             table. rc 0 found · 3 no entry.
+             inputs, score breakdown (raw AND calibration-corrected
+             when a calibration exists), observed columns, the drift
+             verdict, improvement, top of the candidate table.
+             rc 0 found · 3 no entry.
+``ingest``   match an obs run dir's observed rows (measured step time /
+             serve per-token latency, backend-stamped) into the
+             registry's observed columns and re-judge drift:
+             ``ingest <obs_dir>``. rc 0 ingested · 3 nothing matched ·
+             4 every match refused (backend/version/fingerprint gates)
+             · 5 drift band tripped (entry marked stale, schema'd
+             ``autotune_drift`` event fired).
+``calibrate`` fit per-chip-spec, per-ceiling correction factors over
+             every entry's observed columns and write
+             ``calibration.json`` beside the entries (bitwise-
+             deterministic re-fit). rc 0 fitted · 3 no observed
+             samples.
 
 Base-plan selection (all verbs): ``--preset <budget preset>`` (default
 ``tiny_fsdp8``; serve presets imply ``--surface serve``) or ``--config
@@ -27,8 +41,8 @@ resolves them). ``--dir`` overrides the registry directory
 dimensions, ``--budget`` caps full compiles (``AUTOTUNE_BUDGET`` env
 otherwise).
 
-``apply``/``explain`` are static (no compile) and force
-``JAX_PLATFORMS=cpu`` like plancheck instead of re-exec'ing.
+``apply``/``explain``/``ingest``/``calibrate`` are static (no compile)
+and force ``JAX_PLATFORMS=cpu`` like plancheck instead of re-exec'ing.
 """
 
 from __future__ import annotations
@@ -66,8 +80,13 @@ def _base_from_args(args):
 
 
 def _print_score(label: str, score: dict) -> None:
+    cal = score.get("calibration")
+    corrected = (" (calibration-corrected; raw "
+                 f"{score.get('raw_modeled_step_s', float('nan')):.4e}s,"
+                 f" raw binding {cal.get('raw_binding')})"
+                 if cal else "")
     print(f"{label}: modeled {score['modeled_step_s']:.4e}s "
-          f"({score['binding']}-bound on {score['chip']})")
+          f"({score['binding']}-bound on {score['chip']}){corrected}")
     print(f"  t_compute {score['t_compute_s']:.4e}s | "
           f"t_hbm {score['t_hbm_s']:.4e}s | "
           f"t_ici {score['t_ici_s']:.4e}s | "
@@ -81,7 +100,8 @@ def _cmd_search(args, base) -> int:
     from gke_ray_train_tpu.autotune.search import search
     plan, model_cfg, surface, label, config = base
     result = search(plan, model_cfg, surface=surface, dims=args.dims,
-                    budget=args.budget, config=config)
+                    budget=args.budget, config=config,
+                    directory=args.dir)
     print(f"searched {label} ({surface} surface): "
           f"{result['space']['scored']} scored / "
           f"{result['space']['compiled']} compiled / "
@@ -106,11 +126,18 @@ def _cmd_search(args, base) -> int:
 
 
 def _cmd_score(args, base) -> int:
-    from gke_ray_train_tpu.autotune.score import score_candidate
+    from gke_ray_train_tpu.autotune import calibrate
+    from gke_ray_train_tpu.autotune.registry import (
+        chip_digest, registry_dir)
+    from gke_ray_train_tpu.autotune.score import (
+        chip_for_plan, score_candidate)
     from gke_ray_train_tpu.autotune.space import Candidate
     plan, model_cfg, surface, label, _ = base
     score, report = score_candidate(Candidate(plan=plan), model_cfg,
                                     surface=surface)
+    cal = calibrate.load_calibration(args.dir or registry_dir())
+    score = calibrate.apply_to_score(
+        score, cal, chip_digest=chip_digest(chip_for_plan(plan)))
     _print_score(label, score)
     print(json.dumps(report.summary(), indent=1, sort_keys=True))
     return 0
@@ -160,6 +187,27 @@ def _cmd_explain(args) -> int:
           f"({entry.get('improvement', float('nan')):.3f}x modeled)")
     _print_score("  base  ", entry["base_score"])
     _print_score("  winner", entry["score"])
+    observed = entry.get("observed") or []
+    if observed:
+        by_arm: dict = {}
+        for r in observed:
+            by_arm.setdefault(r.get("arm"), []).append(r)
+        print(f"  observed columns: {len(observed)} row(s) — "
+              + ", ".join(f"{arm}: {len(rs)} (backends "
+                          f"{sorted({r.get('backend') for r in rs})})"
+                          for arm, rs in sorted(by_arm.items())))
+    drift = entry.get("drift")
+    if drift:
+        verdict = "STALE (overlay will refuse)" if entry.get("stale") \
+            else "within band"
+        print(f"  drift verdict: {verdict} — {drift.get('arm')} arm "
+              f"corrected {drift.get('corrected_modeled_step_s')}s vs "
+              f"measured {drift.get('measured_step_s')}s "
+              f"(rel_err {drift.get('rel_err')}, band "
+              f"{drift.get('band')})")
+    elif observed:
+        print("  drift verdict: not judged (no calibration for this "
+              "chip yet — run `autotune calibrate`)")
     print(f"  tuned fields: {entry.get('tuned')}")
     if entry.get("env"):
         print(f"  env: {entry['env']}")
@@ -171,10 +219,56 @@ def _cmd_explain(args) -> int:
             table = json.load(f).get("candidates", [])
         print(f"  candidate table ({len(table)} scored, best first):")
         for row in table[:8]:
-            print(f"    {row['fingerprint']} "
+            print(f"    {row.get('fingerprint', row.get('plan_fingerprint'))} "
                   f"{row['score']['modeled_step_s']:.4e}s "
-                  f"{row['diff'] or '[base]'}"
+                  f"{row.get('diff') or '[base]'}"
                   + (f" env {row['env']}" if row.get("env") else ""))
+    return 0
+
+
+def _cmd_ingest(args) -> int:
+    from gke_ray_train_tpu.autotune.registry import (
+        ingest_observed, registry_dir)
+    if not args.obs_dir:
+        raise SystemExit("ingest needs an obs dir: "
+                         "python -m gke_ray_train_tpu.autotune ingest "
+                         "<obs_dir>")
+    summary = ingest_observed(args.obs_dir,
+                              directory=args.dir or registry_dir())
+    print(f"ingested {args.obs_dir} -> {summary['directory']}: "
+          f"{summary['rows']} observed row(s), {summary['matched']} "
+          f"matched, {len(summary['refusals'])} refused, entries "
+          f"updated: {summary['updated'] or 'none'}")
+    for r in summary["refusals"]:
+        print(f"  REFUSED {r}")
+    for d in summary["drift"]:
+        print(f"  DRIFT {d['key']} ({d['arm']} arm): corrected "
+              f"{d['corrected_modeled_step_s']}s vs measured "
+              f"{d['measured_step_s']}s — rel_err {d['rel_err']} > "
+              f"band {d['band']}; entry marked STALE")
+    if summary["drift"]:
+        return 5
+    if summary["matched"] == 0:
+        return 4 if summary["refusals"] else 3
+    return 0
+
+
+def _cmd_calibrate(args) -> int:
+    from gke_ray_train_tpu.autotune.registry import (
+        fit_and_save_calibration, registry_dir)
+    cal = fit_and_save_calibration(args.dir or registry_dir())
+    if not cal.get("_samples"):
+        print(f"no observed samples under "
+              f"{args.dir or registry_dir()} — ingest a run first "
+              "(wrote an empty calibration)")
+        return 3
+    print(f"calibration fitted over {cal['_samples']} sample(s) -> "
+          f"{cal['_path']}")
+    for digest, chip in sorted(cal.get("chips", {}).items()):
+        for ceiling, f in sorted((chip.get("factors") or {}).items()):
+            print(f"  {chip.get('chip')}/{digest} {ceiling}: "
+                  f"x{f['factor']:.4g} (n={f['n']}"
+                  + (", clamped" if f.get("clamped") else "") + ")")
     return 0
 
 
@@ -220,7 +314,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="cost-model-driven ExecutionPlan search + tuned-plan "
                     "registry (CPU-mesh compiles, no accelerator needed)")
     parser.add_argument("command",
-                        choices=("search", "score", "apply", "explain"))
+                        choices=("search", "score", "apply", "explain",
+                                 "ingest", "calibrate"))
+    parser.add_argument("obs_dir", nargs="?", default=None,
+                        help="obs run dir (ingest only): the dir whose "
+                             "observed rows feed the registry")
     parser.add_argument("--preset", default="tiny_fsdp8",
                         help="budget preset naming the base plan + model "
                              "(default tiny_fsdp8; serve presets imply "
@@ -245,12 +343,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="search only — do not write the registry")
     args = parser.parse_args(argv)
 
-    if args.command in ("apply", "explain"):
+    if args.command in ("apply", "explain", "ingest", "calibrate"):
         # static: plan arithmetic + JSON only — never probe a possibly
         # dead accelerator (same discipline as plancheck)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        return (_cmd_apply if args.command == "apply"
-                else _cmd_explain)(args)
+        return {"apply": _cmd_apply, "explain": _cmd_explain,
+                "ingest": _cmd_ingest,
+                "calibrate": _cmd_calibrate}[args.command](args)
 
     if os.environ.get("_AUTOTUNE_CLI_NATIVE") != "1":
         # scoring compiles are only comparable on the canonical

@@ -1,0 +1,253 @@
+"""Calibration: fit the static cost model to what actually ran
+(ISSUE 16 tentpole, part 3).
+
+The scorer's roofline (``score.modeled_step_time``) is deliberately
+nominal — peak FLOPs, peak HBM bandwidth, link speeds off the spec
+sheet. Real steps land somewhere below those ceilings, and by a factor
+that is stable PER CHIP SPEC and PER BINDING CEILING (a compute-bound
+plan mispredicts by the achievable-FLOPs fraction; an HBM-bound one by
+the achievable-bandwidth fraction). So the calibration is exactly that
+table: for each ``chip_digest`` and each ceiling (``compute`` / ``hbm``
+/ ``network``), ONE multiplicative factor fitted by least squares
+through the origin over the registry's observed rows:
+
+    f = sum(measured_i * raw_i) / sum(raw_i ** 2)
+
+clamped to :data:`FACTOR_BAND` (a fake-device CPU mesh measured against
+the nominal CPU spec can be orders of magnitude off the roofline — the
+clamp keeps one absurd row from producing a factor that inverts
+rankings; a clamped factor still moves the prediction TOWARD the
+measurement). The fit is deterministic and bitwise-reproducible: rows
+are sorted before summing, the factor is rounded once, and the JSON is
+written sorted — re-fitting the same registry is a byte-identical
+``calibration.json``.
+
+Applying a calibration (:func:`apply_to_score`) keeps the per-ceiling
+terms RAW (they remain the model's provenance), recomputes the binding
+over the corrected terms, and overwrites ``modeled_step_s`` with the
+corrected prediction while stashing the raw one — both numbers ride
+every downstream score, so "what did the model think before
+calibration" stays answerable. Application is idempotent (it always
+recomputes from the raw terms).
+
+:data:`CALIBRATION_VERSION` joins the registry fingerprint inputs: an
+entry scored under a different calibration regime refuses to overlay,
+the same teeth as scorer-version drift.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+# bumped whenever the calibration model changes shape — part of a
+# registry entry's fingerprint inputs (the scorer-version discipline)
+CALIBRATION_VERSION = 1
+
+CAL_FILENAME = "calibration.json"
+
+# correction factors are clamped here. Wide on purpose: a fake-device
+# CPU mesh measured against the nominal CPU ChipSpec runs ~1-2 orders
+# of magnitude off the roofline and must still calibrate; a factor
+# outside this band means the model and the measurement describe
+# different universes, and trusting it would let one corrupt row flip
+# every ranking.
+FACTOR_BAND: Tuple[float, float] = (1.0 / 128.0, 128.0)
+
+# one rounding, at fit time — the bitwise re-fit contract
+_FACTOR_DIGITS = 9
+
+# the three roofline ceilings a sample can be bound by (score.py terms)
+CEILINGS = ("compute", "hbm", "network")
+
+
+def cal_path(directory: str) -> str:
+    return os.path.join(directory, CAL_FILENAME)
+
+
+def raw_prediction(score: Dict[str, Any],
+                   surface: str = "train") -> Optional[float]:
+    """The UNCALIBRATED prediction hiding in a score dict (which may
+    already be calibrated): per-token on serve, step seconds on train."""
+    if surface == "serve":
+        v = score.get("raw_modeled_per_token_s",
+                      score.get("modeled_per_token_s"))
+    else:
+        v = score.get("raw_modeled_step_s", score.get("modeled_step_s"))
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def raw_binding(score: Dict[str, Any]) -> Optional[str]:
+    """The binding ceiling of the RAW model (calibration may re-rank
+    the ceilings; the fit groups by what the raw model said)."""
+    cal = score.get("calibration") or {}
+    return cal.get("raw_binding") or score.get("binding")
+
+
+def samples_from_entries(entries: List[Dict[str, Any]]
+                         ) -> List[Dict[str, Any]]:
+    """Flatten registry entries' observed columns into fit samples:
+    one ``(chip_digest, ceiling, raw, measured)`` row per observed
+    measurement that carries its raw prediction (ingest stamps it)."""
+    samples: List[Dict[str, Any]] = []
+    for entry in entries:
+        fi = entry.get("fingerprint_inputs") or {}
+        digest = fi.get("chip_digest")
+        if not digest:
+            continue
+        for row in entry.get("observed") or []:
+            raw = row.get("raw_modeled")
+            measured = row.get("measured")
+            ceiling = row.get("binding")
+            if (not isinstance(raw, (int, float)) or raw <= 0
+                    or not isinstance(measured, (int, float))
+                    or measured <= 0 or ceiling not in CEILINGS):
+                continue
+            samples.append({"chip_digest": digest, "chip": fi.get("chip"),
+                            "binding": ceiling, "raw": float(raw),
+                            "measured": float(measured)})
+    return samples
+
+
+def fit_calibration(samples: List[Dict[str, Any]], *,
+                    band: Tuple[float, float] = FACTOR_BAND
+                    ) -> Dict[str, Any]:
+    """Deterministic least-squares factors per (chip digest, ceiling).
+
+    Rows are sorted before summing so float accumulation order — and
+    therefore the resulting JSON — is identical across re-fits of the
+    same registry state."""
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    chip_names: Dict[str, str] = {}
+    for s in samples:
+        groups.setdefault((s["chip_digest"], s["binding"]), []).append(s)
+        if s.get("chip"):
+            chip_names.setdefault(s["chip_digest"], s["chip"])
+    chips: Dict[str, Any] = {}
+    for (digest, ceiling) in sorted(groups):
+        rows = sorted(groups[(digest, ceiling)],
+                      key=lambda r: (r["raw"], r["measured"]))
+        num = sum(r["measured"] * r["raw"] for r in rows)
+        den = sum(r["raw"] ** 2 for r in rows)
+        if den <= 0:
+            continue
+        f = max(band[0], min(band[1], num / den))
+        chip = chips.setdefault(
+            digest, {"chip": chip_names.get(digest), "factors": {}})
+        chip["factors"][ceiling] = {
+            "factor": round(f, _FACTOR_DIGITS),
+            "n": len(rows),
+            "clamped": not (band[0] < num / den < band[1]),
+        }
+    return {
+        "_version": CALIBRATION_VERSION,
+        "band": [band[0], band[1]],
+        "chips": chips,
+    }
+
+
+def save_calibration(cal: Dict[str, Any], directory: str) -> str:
+    """Atomic sorted-JSON write (the registry entry byte discipline)."""
+    os.makedirs(directory, exist_ok=True)
+    path = cal_path(directory)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(cal, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_calibration(directory: Optional[str] = None,
+                     config: Optional[Mapping[str, Any]] = None
+                     ) -> Optional[Dict[str, Any]]:
+    """The registry dir's calibration, or None (no file / unreadable /
+    version drift — all mean "score raw", loudly for the latter two)."""
+    if directory is None:
+        from gke_ray_train_tpu.autotune.registry import registry_dir
+        directory = registry_dir(config)
+    path = cal_path(directory)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            cal = json.load(f)
+    except (OSError, ValueError) as e:
+        logger.warning("autotune: calibration %s unreadable (%s); "
+                       "scoring raw", path, e)
+        return None
+    if cal.get("_version") != CALIBRATION_VERSION:
+        logger.warning(
+            "autotune: calibration %s is version %s (current %s); "
+            "scoring raw — re-run `autotune calibrate`", path,
+            cal.get("_version"), CALIBRATION_VERSION)
+        return None
+    return cal
+
+
+def factors_for(cal: Optional[Dict[str, Any]], chip_digest: str
+                ) -> Optional[Dict[str, Any]]:
+    if not cal:
+        return None
+    chip = (cal.get("chips") or {}).get(chip_digest)
+    return (chip or {}).get("factors") or None
+
+
+def apply_to_score(score: Dict[str, Any],
+                   cal: Optional[Dict[str, Any]], *,
+                   chip_digest: str) -> Dict[str, Any]:
+    """A calibrated copy of ``score`` (the input is never mutated).
+
+    The per-ceiling terms stay RAW; the corrected prediction re-runs
+    the scorer's own combination rule over the scaled terms::
+
+        corrected = max(f_c*t_compute, f_h*t_hbm, f_n*t_net) + f_n*t_net
+
+    Raw prediction and binding are preserved under ``raw_*`` /
+    ``calibration.raw_binding``; ceilings with no fitted factor scale
+    by 1.0. Idempotent: recomputation always starts from the raw
+    terms, so re-applying (any) calibration replaces, never compounds.
+    """
+    factors = factors_for(cal, chip_digest)
+    if not factors:
+        return dict(score)
+    f = {c: float((factors.get(c) or {}).get("factor", 1.0))
+         for c in CEILINGS}
+    t_net = float(score["exposed_penalty_s"])
+    terms = {"compute": f["compute"] * float(score["t_compute_s"]),
+             "hbm": f["hbm"] * float(score["t_hbm_s"]),
+             "network": f["network"] * t_net}
+    binding = max(sorted(terms), key=lambda k: terms[k])
+    raw_step = raw_prediction(score, "train")
+    corrected = terms[binding] + f["network"] * t_net
+    out = dict(score)
+    out["raw_modeled_step_s"] = raw_step
+    out["modeled_step_s"] = corrected
+    out["binding"] = binding
+    out["calibration"] = {
+        "version": cal.get("_version", CALIBRATION_VERSION),
+        "chip_digest": chip_digest,
+        "factors": {c: f[c] for c in CEILINGS},
+        "raw_binding": raw_binding(score),
+    }
+    raw_tok = raw_prediction(score, "serve")
+    if raw_tok is not None and raw_step:
+        out["raw_modeled_per_token_s"] = raw_tok
+        out["modeled_per_token_s"] = raw_tok * (corrected / raw_step)
+    return out
+
+
+def corrected_prediction(score: Dict[str, Any],
+                         cal: Optional[Dict[str, Any]], *,
+                         chip_digest: str,
+                         surface: str = "train") -> Optional[float]:
+    """The calibrated rank-metric value for one score dict."""
+    applied = apply_to_score(score, cal, chip_digest=chip_digest)
+    if surface == "serve" and "modeled_per_token_s" in applied:
+        return float(applied["modeled_per_token_s"])
+    v = applied.get("modeled_step_s")
+    return float(v) if isinstance(v, (int, float)) else None

@@ -75,9 +75,13 @@ def _plan_diff(plan, base, surface: str) -> Dict[str, Any]:
 def search(base_plan, model_cfg, *, surface: str = "train",
            dims: Optional[List[str]] = None,
            budget: Optional[int] = None,
-           config: Mapping[str, Any] = ()) -> Dict[str, Any]:
+           config: Mapping[str, Any] = (),
+           directory: Optional[str] = None) -> Dict[str, Any]:
     """Run the search; returns the result document the registry
     persists (winner + full scored-candidate table + space ledger).
+    When the registry directory holds a calibration
+    (``autotune/calibrate.py``), every score is calibrated before
+    ranking — raw and corrected predictions both ride the table.
 
     Must run on the canonical compile mesh for the base topology (the
     CLI re-execs itself there, like ``perf.budget``).
@@ -86,6 +90,15 @@ def search(base_plan, model_cfg, *, surface: str = "train",
     space: Space = enumerate_space(base_plan, model_cfg, surface=surface,
                                   dims=dims, config=config)
     chip = chip_for_plan(base_plan)
+    from gke_ray_train_tpu.autotune import calibrate as _calibrate
+    from gke_ray_train_tpu.autotune.registry import (
+        chip_digest, registry_dir)
+    cal = _calibrate.load_calibration(
+        directory or registry_dir(dict(config) if config else None))
+    digest = chip_digest(chip)
+    if _calibrate.factors_for(cal, digest):
+        logger.info("autotune: calibration active for chip %s (%s) — "
+                    "ranking corrected predictions", chip.name, digest)
     logger.info("autotune: %d candidate(s) after static pruning "
                 "(%d pruned; dims %s; budget %d compiles)",
                 len(space), len(space.pruned), space.dims, budget)
@@ -120,6 +133,7 @@ def search(base_plan, model_cfg, *, surface: str = "train",
     for cand in to_compile:
         score, report = score_candidate(cand, model_cfg, surface=surface,
                                         chip=chip, _memo=memo)
+        score = _calibrate.apply_to_score(score, cal, chip_digest=digest)
         row = {
             "fingerprint": cand.fingerprint(),
             "plan_fingerprint": cand.plan.fingerprint(),

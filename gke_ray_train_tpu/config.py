@@ -60,7 +60,9 @@ PLAN_SCOPED_KEYS = frozenset({
     # resolved plan before anything compiles. The flag itself is
     # operational (consulting the registry must not stale a sidecar);
     # the overlay re-fingerprints through the fields it changes.
-    "AUTOTUNE",
+    # AUTOTUNE_INGEST opts an autotuned run out of the attempt-end
+    # observed-row feedback hook — operational for the same reason.
+    "AUTOTUNE", "AUTOTUNE_INGEST",
     # kernel & overlap execution path (ROADMAP #3): OVERLAP picks the
     # collective-hiding mode (off | xla | manual), FUSED_OPS routes the
     # memory-bound epilogues through the fused Pallas kernels. Both are
@@ -112,10 +114,13 @@ KNOWN_KEYS = frozenset({
     # autotune registry/search knobs (autotune/): AUTOTUNE_DIR points
     # the tuned-plan registry somewhere other than <repo>/tuned_plans;
     # AUTOTUNE_BUDGET caps the full-compile count the search spends
-    # (successive halving beyond it). Trainer/CLI-scoped like
-    # KERNELCHECK — neither changes the compiled program (the AUTOTUNE
+    # (successive halving beyond it). AUTOTUNE_DRIFT_BAND is the
+    # calibration drift tolerance: |corrected modeled − measured| /
+    # measured beyond it marks a registry entry stale at ingest and
+    # the overlay refuses it. Trainer/CLI-scoped like
+    # KERNELCHECK — none changes the compiled program (the AUTOTUNE
     # flag itself is plan-scoped above).
-    "AUTOTUNE_DIR", "AUTOTUNE_BUDGET",
+    "AUTOTUNE_DIR", "AUTOTUNE_BUDGET", "AUTOTUNE_DRIFT_BAND",
     # kernelcheck (analysis/kernelcheck.py): KERNELCHECK=1 runs the
     # registry's differential startup probe in every worker (each
     # kernel's cheapest case vs its oracle, gated by the pinned

@@ -55,6 +55,14 @@ DIFF_TOLERANCES: Dict[str, float] = {
     "serve_p50_token_latency_s": 2.0,
     "serve_p99_token_latency_s": 2.0,
     **{f"cp_frac_{t}": 0.60 for t in LEDGER_TERMS},
+    # autotune calibration drift (autotune/registry.py ingest): the
+    # drift-event count is exact — a calibrated cost model tripping
+    # the band where the recorded run had zero drift IS the
+    # regression; the worst relative error gets a wide band (it only
+    # exists when drift fired, and its magnitude is machine-sensitive)
+    "autotune_drift_events": 0.0,
+    "autotune_drift_stale": 0.0,
+    "autotune_drift_max_rel_err": 1.0,
 }
 # composition fields where both sides below this share are noise
 NOISE_FLOOR = 0.02
@@ -102,6 +110,17 @@ def flatten_report(report: Dict[str, Any]) -> Dict[str, float]:
         for t in LEDGER_TERMS:
             if t in cp_sum:
                 flat[f"cp_frac_{t}"] = cp_sum[t] / cp_wall
+    # autotune feedback scalars (report "autotune" section): the drift
+    # counts pin the calibration loop — a model that starts
+    # mispredicting real runs shows up as a count where the recorded
+    # drill had none
+    at = report.get("autotune") or {}
+    if at:
+        flat["autotune_drift_events"] = float(at.get("drift_events", 0))
+        flat["autotune_drift_stale"] = float(at.get("drift_stale", 0))
+        if at.get("drift_max_rel_err") is not None:
+            flat["autotune_drift_max_rel_err"] = \
+                float(at["drift_max_rel_err"])
     return {k: round(v, 6) for k, v in flat.items()}
 
 

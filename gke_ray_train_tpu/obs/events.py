@@ -45,7 +45,7 @@ EVENT_KINDS: Dict[str, tuple] = {
     "attempt_start": ("topology", "n_devices", "pool", "mesh"),
     "resume": ("resumed_step",),
     "first_step": ("compile_s", "restart_to_first_step_s",
-                   "fast_forward_s", "restore_s"),
+                   "fast_forward_s", "restore_s", "backend"),
     "step": ("epoch", "loss", "learning_rate", "grad_norm",
              "tokens_per_sec_per_chip", "mfu", "data_stall_frac"),
     "eval": ("metrics",),
@@ -75,6 +75,12 @@ EVENT_KINDS: Dict[str, tuple] = {
     "autotune_result": ("key", "winner", "base", "winner_step_s",
                         "base_step_s", "improvement", "candidates",
                         "compiled", "pruned"),
+    # calibration drift teeth (autotune/registry.py ingest): fired when
+    # an entry's corrected prediction misses the measured value by more
+    # than AUTOTUNE_DRIFT_BAND (the entry goes stale in the same breath)
+    "autotune_drift": ("key", "arm", "measured_step_s",
+                       "raw_modeled_step_s", "corrected_modeled_step_s",
+                       "rel_err", "band", "stale"),
     # entry-script artifacts
     "export": ("path", "what"),
 }

@@ -81,6 +81,10 @@ METRIC_NAMES: Dict[str, str] = {
     "serve_p50_token_latency_s": "gauge",
     "serve_p99_token_latency_s": "gauge",
     "serve_batch_occupancy": "gauge",
+    # admitted request length (prompt + max_new_tokens) at the engine's
+    # submit path — the workload-shape distribution bucket-padding and
+    # MAX_BATCH tuning decisions are made against
+    "request_len": "histogram",
 }
 
 PROM_PREFIX = "grt_"      # gke_ray_train_tpu, short for scrape configs

@@ -148,6 +148,13 @@ def build_report(run_dir: str) -> Dict[str, Any]:
                                  "kind")}}
                 for e in evs if e["kind"] not in ("step",)],
             "steps_logged": sum(1 for e in evs if e["kind"] == "step"),
+            # the backend the attempt ACTUALLY ran on (first_step
+            # stamps it): `autotune ingest` filters on this so a
+            # cpu-fallback measurement can never calibrate a TPU
+            # ChipSpec — the report carries it through
+            "backend": next((e.get("backend") for e in evs
+                             if e["kind"] == "first_step"
+                             and e.get("backend")), None),
         }
         if end.get("event"):
             att["event"] = end["event"]          # shrink | grow
@@ -276,6 +283,35 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         if vals:
             network[key] = max(vals)
 
+    # -- autotune feedback loop (autotune/registry.py ingest): an
+    # autotune_drift event in the stream means a calibrated cost model
+    # mispredicted a real run — counted and the worst relative error
+    # surfaced as report scalars, so an `obs diff` baseline pins a
+    # silently-degrading model (a drift event appearing where the
+    # recorded run had none trips the gate)
+    drift_events = [e for e in events if e["kind"] == "autotune_drift"]
+    n_candidates = sum(1 for e in events
+                       if e["kind"] == "autotune_candidate")
+    n_results = sum(1 for e in events if e["kind"] == "autotune_result")
+    autotune_section = None
+    if drift_events or n_candidates or n_results:
+        autotune_section = {
+            "candidates": n_candidates,
+            "results": n_results,
+            "drift_events": len(drift_events),
+            "drift_stale": sum(1 for e in drift_events
+                               if e.get("stale")),
+        }
+        if drift_events:
+            worst = max(drift_events,
+                        key=lambda e: float(e.get("rel_err") or 0.0))
+            autotune_section["drift_max_rel_err"] = worst.get("rel_err")
+            autotune_section["drift_band"] = worst.get("band")
+            autotune_section["drift_keys"] = sorted(
+                {e.get("key") for e in drift_events if e.get("key")})
+
+    backends = sorted({a["backend"] for a in attempts if a.get("backend")})
+
     run_end = next((e for e in reversed(events)
                     if e["kind"] == "run_end"), None)
     report = {
@@ -287,6 +323,9 @@ def build_report(run_dir: str) -> Dict[str, Any]:
         "preemptions": run_end.get("preemptions") if run_end else None,
         "goodput": totals or None,
         "network": network or None,
+        "backend": (backends[0] if len(backends) == 1
+                    else (backends or None)),
+        "autotune": autotune_section,
         "reconciled": reconciled,
         # span/ledger cross-stream verification (obs/critical.py):
         # True when no attempt has spans, or every attempt's span-
@@ -322,6 +361,18 @@ def render_text(report: Dict[str, Any]) -> str:
     if net:
         L.append("  network: ici {:,}B dcn {:,}B per step".format(
             int(net.get("ici_bytes", 0)), int(net.get("dcn_bytes", 0))))
+    if report.get("backend"):
+        L.append(f"  backend: {report['backend']}")
+    at = report.get("autotune")
+    if at:
+        line = (f"  autotune: {at['candidates']} candidate(s), "
+                f"{at['results']} result(s), {at['drift_events']} "
+                f"drift event(s)")
+        if at["drift_events"]:
+            line += (f" — {at['drift_stale']} STALE, worst rel err "
+                     f"{at.get('drift_max_rel_err')} vs band "
+                     f"{at.get('drift_band')} ({at.get('drift_keys')})")
+        L.append(line)
     for a in report["attempts"]:
         head = f"attempt {a['attempt']}: {a['status']}"
         if a.get("event"):

@@ -220,6 +220,14 @@ class ExecutionPlan:
     # re-fingerprints the plan through them, exactly like spelling the
     # tuned values by hand. Excluded from COMPILE_SURFACES like OBS.
     autotune: bool = False
+    # AUTOTUNE_INGEST=0 opts an autotuned run OUT of the attempt-end
+    # feedback hook (rayint/trainer.py): with obs active, rank 0 of an
+    # AUTOTUNE=1 attempt ingests its own observed step times into the
+    # registry's observed columns (autotune/registry.py) so
+    # `calibrate` can fit the cost model against reality and the drift
+    # band can catch a stale entry. Operational like `autotune` itself
+    # — excluded from COMPILE_SURFACES.
+    autotune_ingest: bool = True
 
     # -- overlap / fused-kernel execution path (ROADMAP #3) -------------
     # communication/compute overlap mode for the train step:
@@ -711,6 +719,7 @@ CONFIG_KEYS: Dict[str, str] = {
     "obs_capture_budget": "OBS_CAPTURE_BUDGET",
     "trace": "TRACE",
     "autotune": "AUTOTUNE",
+    "autotune_ingest": "AUTOTUNE_INGEST",
     "overlap": "OVERLAP",
     "fused_ops": "FUSED_OPS",
     "dcn_sync": "DCN_SYNC",
@@ -882,13 +891,15 @@ ENV_FORWARD_KEYS: Tuple[str, ...] = tuple(sorted(
         # DCN gradient-sync arms (`env DCN_SYNC=hier DCN_COMPRESS=bf16`)
         "overlap", "fused_ops", "dcn_sync", "dcn_compress",
         # a driver-side `env AUTOTUNE=1` must reach every worker's
-        # registry lookup (autotune/registry.py)
-        "autotune")))
+        # registry lookup (autotune/registry.py) — and AUTOTUNE_INGEST
+        # its attempt-end observed-row feedback hook
+        "autotune", "autotune_ingest")))
 
 _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
                           "divergence_guard", "obs", "obs_capture",
-                          "trace", "fused_ops", "autotune"})
+                          "trace", "fused_ops", "autotune",
+                          "autotune_ingest"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",

@@ -201,6 +201,54 @@ def _is_nonretryable(e: BaseException) -> bool:
     return False
 
 
+def _maybe_ingest_observed(obs, plan, config: dict) -> None:
+    """Attempt-end feedback hook (autotune/registry.py): rank 0 of an
+    AUTOTUNE=1 + obs-active attempt ingests its own observed rows back
+    into the tuned-plan registry, so calibration data and drift alarms
+    accumulate from real runs without separate tooling. Never fatal —
+    a broken registry must not turn a finished attempt into a failure
+    — and each row is refused on fingerprint/chip/backend drift
+    exactly like ``apply``. AUTOTUNE_INGEST=0 opts out."""
+    if obs is None or plan is None:
+        return
+    if not (getattr(plan, "autotune", False)
+            and getattr(plan, "autotune_ingest", True)):
+        return
+    if str(getattr(obs, "rank", None)) != "0":
+        return                     # one writer per attempt, like apply
+    try:
+        from gke_ray_train_tpu.autotune.registry import (
+            entry_key, ingest_observed, model_digest, registry_dir)
+        # map THIS attempt's runtime fingerprint onto its registry arm:
+        # the runtime plan fingerprint covers operational fields the
+        # search-time base/winner fingerprints don't, so the entry's
+        # own arm map would never match it
+        arms = {}
+        key = getattr(plan, "_tuned_key", None)
+        arm = "tuned"
+        if key is None:
+            arm = "base"
+            from gke_ray_train_tpu.analysis.plancheck import (
+                model_config_for)
+            model_cfg = model_config_for(dict(config or {}), plan)
+            if model_cfg is not None:
+                key = entry_key(model_digest(model_cfg), plan.topology,
+                                "train")
+        if key is not None:
+            arms[plan.fingerprint()] = (key, arm)
+        summary = ingest_observed(
+            obs.obs_dir, directory=registry_dir(config), config=config,
+            runtime_arms=arms, log=logger)
+        if summary["matched"] or summary["refusals"] or summary["drift"]:
+            logger.info(
+                "autotune ingest: %d observed row(s) matched, "
+                "%d refusal(s), %d drift verdict(s) under %s",
+                summary["matched"], len(summary["refusals"]),
+                len(summary["drift"]), summary["directory"])
+    except Exception as e:  # noqa: BLE001 - feedback must never be fatal
+        logger.warning("autotune ingest hook skipped: %s", e)
+
+
 def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
                 beat_fn: Optional[Callable] = None) -> dict:
     """Returns {"metrics", "resumed_step", "goodput",
@@ -320,6 +368,10 @@ def _run_worker(fn: Callable, config: dict, env: Dict[str, str],
             "ok" if _exc is None else
             ("preempted" if _find_preempted(_exc) is not None
              else "failed"))
+        # the sealed obs dir now holds this attempt's measured rows —
+        # feed them back to the registry (rank 0, AUTOTUNE=1, never
+        # fatal; AUTOTUNE_INGEST=0 opts out)
+        _maybe_ingest_observed(obs, plan, config)
         # one line of compile-cache health per attempt: a warm restart
         # should show hits ≈ compile count and seconds saved
         log_cache_summary(logger)
@@ -641,7 +693,8 @@ class JaxTrainer:
             env_base.update({k: os.environ[k]
                              for k in ("ELASTIC", "MIN_DEVICES",
                                        "NUM_SLICES", "KERNELCHECK",
-                                       "AUTOTUNE_DIR")
+                                       "AUTOTUNE_DIR",
+                                       "AUTOTUNE_DRIFT_BAND")
                              if k in os.environ})
             env_base.update(self._pool_env())
             futures = [

@@ -410,6 +410,17 @@ class BatchEngine:
                               label=f"request {request.rid} prompt")
         bucket = pick_bucket(len(ids), request.max_new_tokens,
                              self.buckets, self.cfg.max_seq_len)
+        # obs: the admitted request's total length (post-truncation
+        # prompt + decode budget) into the shared metrics registry —
+        # the workload-shape histogram bucket declarations are tuned
+        # against. No-op when obs is off.
+        from gke_ray_train_tpu.obs import runtime as obs_runtime
+        if obs_runtime.active() is not None:
+            try:
+                obs_runtime.registry().histogram("request_len").observe(
+                    float(len(ids) + request.max_new_tokens))
+            except Exception:  # noqa: BLE001 - telemetry must not reject
+                pass
         request = dataclasses.replace(request, token_ids=ids)
         self._pending.append(request)
         self._pending_bucket[request.rid] = bucket

@@ -403,12 +403,15 @@ def run_training(state: TrainState,
                         obs.span_add("fast_forward", max(ff_dt, 0.0),
                                      step=global_step + 1)
                 if obs is not None:
+                    from gke_ray_train_tpu.obs import (
+                        runtime as _obs_runtime)
                     obs.emit("first_step", step=global_step + 1,
                              compile_s=loop_timing["compile_s"],
                              restart_to_first_step_s=loop_timing[
                                  "restart_to_first_step_s"],
                              restore_s=ledger.restore_s,
-                             fast_forward_s=ledger.fast_forward_s)
+                             fast_forward_s=ledger.fast_forward_s,
+                             backend=_obs_runtime.current_backend())
             else:
                 state, m = train_step(state, batch)
             global_step += 1

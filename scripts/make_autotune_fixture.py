@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Regenerate the checked-in autotune calibration fixtures.
+
+Writes three sibling fixture dirs under ``tests/fixtures/``:
+
+- ``autotune_registry/`` — ONE registry entry for the ``tiny_fsdp8``
+  preset (real base/winner plan fingerprints, real model digest, real
+  CPU chip digest) whose score dicts are synthetic-but-well-formed
+  roofline breakdowns. Synthetic on purpose: the fixture must stay
+  byte-stable across machines, and the calibration loop only cares
+  that measured/modeled pairs relate deterministically.
+- ``autotune_obs/`` — an obs dir whose ``bench_records.jsonl``
+  measures BOTH arms at exactly 2x the modeled step time, so
+  ``autotune calibrate`` fits a compute factor of exactly 2.0 and the
+  corrected prediction lands within the drift band.
+- ``autotune_obs_doctored/`` — same arms measured at 10x: ingesting it
+  against the fitted calibration must trip ``AUTOTUNE_DRIFT_BAND``
+  (the rc=5 contract the CI smoke and tests/test_autotune.py pin).
+
+Deterministic by construction — rerunning this script must be a
+no-op diff. CI copies ``autotune_registry/`` to a scratch dir before
+ingesting (ingest mutates entries in place).
+
+Usage: JAX_PLATFORMS=cpu python scripts/make_autotune_fixture.py
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+
+# synthetic roofline breakdowns (seconds). Chosen so the measured
+# fixtures below fit a compute factor of EXACTLY 2.0:
+#   f = sum(m*p)/sum(p^2) with m_i = 2*p_i  ->  f = 2
+BASE_SCORE = {
+    "chip": "cpu",
+    "t_compute_s": 0.02,
+    "t_hbm_s": 0.01,
+    "t_ici_s": 0.003,
+    "t_dcn_s": 0.0,
+    "exposed_penalty_s": 0.003,
+    "binding": "compute",
+    "mfu_ceiling": 0.5,
+    "modeled_step_s": 0.023,
+}
+WINNER_SCORE = {
+    "chip": "cpu",
+    "t_compute_s": 0.016,
+    "t_hbm_s": 0.01,
+    "t_ici_s": 0.003,
+    "t_dcn_s": 0.0,
+    "exposed_penalty_s": 0.003,
+    "binding": "compute",
+    "mfu_ceiling": 0.5,
+    "modeled_step_s": 0.019,
+}
+MEASURED_FACTOR_GOOD = 2.0       # within AUTOTUNE_DRIFT_BAND once fitted
+MEASURED_FACTOR_DOCTORED = 10.0  # trips the band against that same fit
+
+
+def build_entry(directory: str) -> dict:
+    from gke_ray_train_tpu.autotune.registry import save_entry
+    from gke_ray_train_tpu.autotune.space import TUNABLE_FIELDS
+    from gke_ray_train_tpu.perf.budget import (
+        plan_for_preset, preset_model_cfg)
+
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    winner = dataclasses.replace(base, fused_ops=True)
+    base_row = {"fingerprint": base.fingerprint(),
+                "plan_fingerprint": base.fingerprint(),
+                "score": dict(BASE_SCORE), "diff": {}, "env": None,
+                "distance": 0}
+    winner_row = {"fingerprint": winner.fingerprint(),
+                  "plan_fingerprint": winner.fingerprint(),
+                  "score": dict(WINNER_SCORE),
+                  "diff": {"fused_ops": [False, True]}, "env": None,
+                  "distance": 1}
+    result = {
+        "surface": "train",
+        "chip": "cpu",
+        "scorer_version": 1,
+        "base": base_row,
+        "winner": winner_row,
+        "winner_tuned_fields": {f: getattr(winner, f)
+                                for f in TUNABLE_FIELDS["train"]},
+        "winner_env": {},
+        "improvement": round(BASE_SCORE["modeled_step_s"]
+                             / WINNER_SCORE["modeled_step_s"], 6),
+        "candidates": [winner_row, base_row],
+        "space": {"enumerated": 2, "statically_pruned": 0,
+                  "coarse_skipped": 0, "compiled": 2, "scored": 2,
+                  "dims": ["fused"]},
+        "pruned": [],
+    }
+    path = save_entry(result, base_plan=base, model_cfg=cfg,
+                      directory=directory)
+    # the jax version stamp is provenance on real entries but noise in
+    # a checked-in fixture — pin it so regeneration is byte-stable
+    with open(path) as f:
+        doc = json.load(f)
+    doc["_recorded_with"] = {"jax": "fixture"}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return {"base_fp": base.fingerprint(),
+            "winner_fp": winner.fingerprint(), "path": path}
+
+
+def write_obs_dir(directory: str, fps: dict, factor: float,
+                  run_id: str) -> None:
+    os.makedirs(directory, exist_ok=True)
+    rec = {
+        "metric": "autotune default-vs-tuned fixture record",
+        "value": 1.0,
+        "unit": "x",
+        "run_id": run_id,
+        "backend": "cpu",
+        "topology": "cpu-8",
+        "steps": 5,
+        "plan_fingerprint_default": fps["base_fp"],
+        "plan_fingerprint_tuned": fps["winner_fp"],
+        "measured_step_s_default": round(
+            factor * BASE_SCORE["modeled_step_s"], 6),
+        "measured_step_s_tuned": round(
+            factor * WINNER_SCORE["modeled_step_s"], 6),
+    }
+    with open(os.path.join(directory, "bench_records.jsonl"), "w") as f:
+        f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+
+def main() -> None:
+    reg_dir = os.path.join(FIXTURES, "autotune_registry")
+    os.makedirs(reg_dir, exist_ok=True)
+    fps = build_entry(reg_dir)
+    write_obs_dir(os.path.join(FIXTURES, "autotune_obs"), fps,
+                  MEASURED_FACTOR_GOOD, "fixture-good")
+    write_obs_dir(os.path.join(FIXTURES, "autotune_obs_doctored"), fps,
+                  MEASURED_FACTOR_DOCTORED, "fixture-doctored")
+    print(f"fixtures written under {FIXTURES}")
+    print(f"  entry: {fps['path']}")
+    print(f"  base {fps['base_fp']} winner {fps['winner_fp']}")
+
+
+if __name__ == "__main__":
+    main()

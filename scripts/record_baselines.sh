@@ -101,6 +101,20 @@ run obs-report     python -m gke_ray_train_tpu.obs report "$OBS_ELASTIC_DIR"
 run obs-diff       python -m gke_ray_train_tpu.obs diff "$OBS_ELASTIC_DIR" \
     tests/regressions/elastic_cpu8.json
 
+# close the loop (ISSUE 16): fold the elastic drill's observed
+# telemetry back into the autotune registry. `ingest` matches each
+# bench/goodput record to a registry arm by plan fingerprint under the
+# surface/chip/backend refusal gates (a cpu-fallback run can NEVER
+# calibrate a TPU entry; rc=3 just means nothing matched this dir —
+# not a failure on a fresh registry), then `calibrate` re-fits the
+# per-chip correction factors from everything observed so far. A
+# drift trip here (rc=5) marks the entry STALE — the overlay refuses
+# it until re-tuned, so treat it like a failed budget check.
+run autotune-ingest    python -m gke_ray_train_tpu.autotune ingest \
+    "$OBS_ELASTIC_DIR" --dir tuned_plans
+run autotune-calibrate python -m gke_ray_train_tpu.autotune calibrate \
+    --dir tuned_plans
+
 # compile-cost budgets (tests/budgets/*.json) are recorded on the
 # canonical 8-fake-device CPU mesh, NOT on the attached chip — the CLI
 # re-execs itself there; `check` is what tier-1 runs. `--all` sweeps

@@ -74,3 +74,27 @@ def fsdp_mesh(devices):
 def tp_mesh(devices):
     """2 fsdp x 2 model x 2 context — every parallelism axis live."""
     return build_mesh(MeshConfig(data=1, fsdp=2, model=2, context=2), devices)
+
+
+@pytest.fixture(scope="session")
+def tiny_train_setup():
+    """One meshless tiny model + ONE jitted train step, shared across
+    the heaviest suites (test_obs and friends rebuilt this exact
+    scaffolding per test, paying the same compile 6+ times). Safe to
+    share: the state pytree is immutable and the step was built with
+    donate=False, so every consumer starts from the identical step-0
+    state and the suite compiles the program once. The loop's
+    ``compile`` span/ledger term still books on every run — it times
+    the first step CALL, warm or cold."""
+    import jax as _jax
+
+    from gke_ray_train_tpu.models import tiny
+    from gke_ray_train_tpu.train import (
+        make_optimizer, make_train_state, make_train_step)
+    cfg = tiny(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, _jax.random.key(0))
+    step = make_train_step(cfg, opt, donate=False)
+    return cfg, opt, state, step

@@ -465,3 +465,386 @@ def test_budget_cli_all_excludes_names():
     with pytest.raises(SystemExit) as e:
         main(["check", "tiny_fsdp8", "--all"])
     assert e.value.code == 2
+
+
+# ---------------------------------------------------------------------------
+# observed columns -> calibration -> drift (ISSUE 16: the feedback loop)
+# ---------------------------------------------------------------------------
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+OBS_GOOD = os.path.join(FIXTURES, "autotune_obs")
+OBS_DOCTORED = os.path.join(FIXTURES, "autotune_obs_doctored")
+
+
+@pytest.fixture
+def fixture_registry(tmp_path):
+    """A scratch COPY of the checked-in fixture registry — ingest
+    mutates entries in place, and drift emits events into the obs dir,
+    so the checked-in fixtures must never be pointed at directly for
+    anything that writes (scripts/make_autotune_fixture.py regenerates
+    them)."""
+    import shutil
+    dst = str(tmp_path / "registry")
+    shutil.copytree(os.path.join(FIXTURES, "autotune_registry"), dst)
+    return dst
+
+
+def _one_entry(directory):
+    from gke_ray_train_tpu.autotune import registry
+    [(path, entry)] = registry.list_entries(directory)
+    return path, entry
+
+
+def _rewrite_entry(path, entry):
+    with open(path, "w") as f:
+        json.dump(entry, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def test_ingest_then_calibrate_corrects_toward_measured(fixture_registry):
+    """The acceptance loop: measured rows land as observed columns,
+    the fit recovers the fixture's engineered 2.0x compute factor
+    EXACTLY (least-squares over measured = 2 * modeled), and the
+    corrected prediction is closer to the measured value than the raw
+    one — on BOTH arms."""
+    from gke_ray_train_tpu.autotune import calibrate, registry
+    s = registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    assert s["rows"] == 2 and s["matched"] == 2 and not s["refusals"]
+    assert not s["calibrated"]        # no factors existed yet
+    cal_doc = registry.fit_and_save_calibration(fixture_registry)
+    assert cal_doc["_samples"] == 2
+    cal = calibrate.load_calibration(fixture_registry)
+    _, entry = _one_entry(fixture_registry)
+    digest = entry["fingerprint_inputs"]["chip_digest"]
+    assert cal["chips"][digest]["factors"]["compute"]["factor"] == 2.0
+    assert cal["chips"][digest]["factors"]["compute"]["clamped"] is False
+    for arm, score in (("base", entry["base_score"]),
+                       ("tuned", entry["score"])):
+        rows = [r for r in entry["observed"] if r["arm"] == arm]
+        assert len(rows) == 1
+        assert rows[0]["backend"] == "cpu"      # stamped, not inferred
+        assert rows[0]["raw_modeled"] == score["modeled_step_s"]
+        measured = rows[0]["measured"]
+        raw = calibrate.raw_prediction(score, "train")
+        corrected = calibrate.corrected_prediction(
+            score, cal, chip_digest=digest, surface="train")
+        assert abs(corrected - measured) < abs(raw - measured), arm
+
+
+def test_apply_to_score_idempotent_with_provenance():
+    """Calibration rewrites the prediction, never the terms: raw
+    prediction + raw binding survive as provenance, re-applying
+    replaces instead of compounding, and an unknown chip digest is a
+    no-op copy."""
+    from gke_ray_train_tpu.autotune import calibrate
+    score = {"chip": "cpu", "t_compute_s": 0.02, "t_hbm_s": 0.01,
+             "t_ici_s": 0.003, "t_dcn_s": 0.0,
+             "exposed_penalty_s": 0.003, "binding": "compute",
+             "mfu_ceiling": 0.5, "modeled_step_s": 0.023}
+    cal = calibrate.fit_calibration([
+        {"chip_digest": "d", "chip": "cpu", "binding": "compute",
+         "raw": 0.023, "measured": 0.046}])
+    once = calibrate.apply_to_score(score, cal, chip_digest="d")
+    assert calibrate.apply_to_score(once, cal, chip_digest="d") == once
+    assert once["raw_modeled_step_s"] == 0.023
+    assert once["calibration"]["raw_binding"] == "compute"
+    assert once["calibration"]["factors"]["compute"] == 2.0
+    # corrected = max(2*.02, 1*.01, 1*.003) + 1*.003
+    assert once["modeled_step_s"] == pytest.approx(0.043)
+    assert once["t_compute_s"] == 0.02          # terms stay raw
+    assert score["modeled_step_s"] == 0.023     # input not mutated
+    same = calibrate.apply_to_score(score, cal, chip_digest="other")
+    assert same == score and same is not score
+
+
+def test_reingest_and_refit_bitwise_idempotent(fixture_registry):
+    """Re-ingesting the same run dir and re-fitting the same registry
+    state are BYTE-level no-ops — rows dedupe on their identity key,
+    floats were rounded once at extraction, and the fit sums in sorted
+    order."""
+    from gke_ray_train_tpu.autotune import calibrate, registry
+    registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    registry.fit_and_save_calibration(fixture_registry)
+    # second ingest re-judges drift (in band) and writes the verdict
+    s = registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    assert s["calibrated"] and not s["drift"]
+    path, entry = _one_entry(fixture_registry)
+    assert entry["drift"]["stale"] is False
+    assert entry["drift"]["rel_err"] <= entry["drift"]["band"]
+    with open(path, "rb") as f:
+        entry_bytes = f.read()
+    with open(calibrate.cal_path(fixture_registry), "rb") as f:
+        cal_bytes = f.read()
+    registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    registry.fit_and_save_calibration(fixture_registry)
+    with open(path, "rb") as f:
+        assert f.read() == entry_bytes
+    with open(calibrate.cal_path(fixture_registry), "rb") as f:
+        assert f.read() == cal_bytes
+
+
+def test_drift_trips_stale_event_and_overlay_refusal(fixture_registry,
+                                                     tmp_path, caplog):
+    """The teeth, end to end: the doctored run (10x the model) trips
+    the band -> rc 5, the entry goes STALE, a schema-valid
+    autotune_drift event lands in the run dir, validate_entry names
+    the drift, and maybe_apply REFUSES while the run continues
+    untuned. A healthier re-judge under a wider band then CLEARS the
+    flag — self-correcting, not a one-way latch."""
+    import shutil
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.autotune.__main__ import main
+    from gke_ray_train_tpu.obs.events import (
+        STAMP_FIELDS, iter_events, validate_event)
+    obs_doc = str(tmp_path / "obs_doctored")
+    shutil.copytree(OBS_DOCTORED, obs_doc)
+    assert main(["ingest", OBS_GOOD, "--dir", fixture_registry]) == 0
+    assert main(["calibrate", "--dir", fixture_registry]) == 0
+    assert main(["ingest", obs_doc, "--dir", fixture_registry]) == 5
+    _, entry = _one_entry(fixture_registry)
+    assert entry["stale"] is True
+    assert entry["drift"]["rel_err"] > entry["drift"]["band"]
+    # the drift event is real telemetry: schema-valid, in the run dir
+    evs = list(iter_events(obs_doc, kinds=("autotune_drift",)))
+    assert len(evs) == 1
+    payload = {k: v for k, v in evs[0].items()
+               if k not in STAMP_FIELDS}
+    validate_event("autotune_drift", payload)
+    assert payload["stale"] is True and payload["key"] == entry["key"]
+    # overlay refusal: loud, named, and the plan keeps running untuned
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    findings = registry.validate_entry(entry, base, cfg)
+    assert any("STALE" in f for f in findings)
+    armed = dataclasses.replace(base, autotune=True)
+    with caplog.at_level("WARNING"):
+        plan, applied = registry.maybe_apply(
+            armed, model_cfg=cfg,
+            config={"AUTOTUNE_DIR": fixture_registry})
+    assert plan is armed and not applied
+    assert any("REFUSING" in r.getMessage() for r in caplog.records)
+    # explain surfaces the verdict without crashing on a stale entry
+    assert main(["explain", "--dir", fixture_registry]) == 0
+    # the same evidence re-judged under a wider band clears the flag
+    s = registry.ingest_observed(obs_doc, directory=fixture_registry,
+                                 band=10.0)
+    assert not s["drift"]
+    _, entry = _one_entry(fixture_registry)
+    assert "stale" not in entry and entry["drift"]["stale"] is False
+
+
+def test_ingest_refusal_matrix(fixture_registry):
+    """Row gates in refusal order (surface, topology, chip family,
+    backend missing, backend-vs-chip both directions) plus the
+    entry-level version gates that refuse BEFORE any row lands."""
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.autotune.__main__ import main
+    path, entry = _one_entry(fixture_registry)
+    row = {"surface": "train", "topology": "cpu-8",
+           "chip_family": "cpu", "backend": "cpu"}
+    assert registry._row_refusal(row, entry) is None
+    assert "surface mismatch" in registry._row_refusal(
+        {**row, "surface": "serve"}, entry)
+    assert "topology drift" in registry._row_refusal(
+        {**row, "topology": "cpu-4"}, entry)
+    assert "no backend stamp" in registry._row_refusal(
+        {**row, "backend": None}, entry)
+    # cpu-fallback measurements are fine against the CPU ChipSpec...
+    assert registry._row_refusal(
+        {**row, "backend": "cpu-fallback"}, entry) is None
+    # ...but a real-backend number is not evidence about the CPU spec
+    assert "does not describe" in registry._row_refusal(
+        {**row, "backend": "tpu"}, entry)
+    # THE gate, inverted: host numbers can never calibrate a TPU entry
+    v5e = json.loads(json.dumps(entry))
+    v5e["topology"] = "v5e-8"
+    v5e["fingerprint_inputs"]["chip"] = "v5e"
+    tpu_row = {"surface": "train", "topology": "v5e-8",
+               "chip_family": "v5e", "backend": "cpu-fallback"}
+    assert "can NEVER calibrate" in registry._row_refusal(tpu_row, v5e)
+    # an unknown chip family is host evidence (scored as cpu), so it
+    # is chip-family-refused against the v5e entry too
+    assert "chip family drift" in registry._row_refusal(
+        {**tpu_row, "chip_family": "weird"}, v5e)
+
+    # entry-level version gates: fingerprint-matched rows exist but
+    # every entry refuses -> rc 4, and nothing is written
+    for field, bogus in (("scorer_version", -1),
+                         ("calibration_version", -1)):
+        doctored = json.loads(json.dumps(entry))
+        doctored["fingerprint_inputs"][field] = bogus
+        _rewrite_entry(path, doctored)
+        assert main(["ingest", OBS_GOOD, "--dir",
+                     fixture_registry]) == 4
+        _, now = _one_entry(fixture_registry)
+        assert not now.get("observed")
+    # restore -> nothing-matched contract on an EMPTY obs dir is rc 3
+    _rewrite_entry(path, entry)
+    empty = os.path.join(fixture_registry, "empty_obs")
+    os.makedirs(empty)
+    assert main(["ingest", empty, "--dir", fixture_registry]) == 3
+    # calibrate with no observed rows anywhere: rc 3 too
+    assert main(["calibrate", "--dir", fixture_registry]) == 3
+
+
+def test_cpu_fallback_never_calibrates_tpu_entry(fixture_registry,
+                                                 tmp_path, capsys):
+    """The satellite-3 regression, full-ingest path: re-key the
+    fixture entry as a v5e tune, measure the SAME fingerprints on a
+    cpu-fallback host — ingest must refuse every row (rc 4) and the
+    entry must gain zero observed columns."""
+    from gke_ray_train_tpu.autotune.__main__ import main
+    path, entry = _one_entry(fixture_registry)
+    entry["topology"] = "v5e-8"
+    entry["key"] = entry["key"].replace("cpu-8", "v5e-8")
+    entry["fingerprint_inputs"]["chip"] = "v5e"
+    os.remove(path)
+    _rewrite_entry(path.replace("cpu-8", "v5e-8"), entry)
+    with open(os.path.join(OBS_GOOD, "bench_records.jsonl")) as f:
+        rec = json.loads(f.readline())
+    rec["backend"] = "cpu-fallback"
+    rec["topology"] = "v5e-8"
+    obs = tmp_path / "obs_fallback"
+    obs.mkdir()
+    (obs / "bench_records.jsonl").write_text(json.dumps(rec) + "\n")
+    assert main(["ingest", str(obs), "--dir", fixture_registry]) == 4
+    assert "can NEVER calibrate" in capsys.readouterr().out
+    _, now = _one_entry(fixture_registry)
+    assert not now.get("observed")
+
+
+def test_observed_columns_survive_entry_rerecord(fixture_registry):
+    """A re-tune whose arms keep their plan fingerprints carries the
+    observed evidence forward (re-stamped against the new scores);
+    rows about plans the entry no longer proposes — and any stale /
+    drift verdict — are dropped for the next ingest to re-judge."""
+    from gke_ray_train_tpu.autotune import registry
+    registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    path, entry = _one_entry(fixture_registry)
+    assert {r["arm"] for r in entry["observed"]} == {"base", "tuned"}
+    result = {
+        "surface": "train",
+        "scorer_version": entry["fingerprint_inputs"]["scorer_version"],
+        "base": {"plan_fingerprint": entry["base_fingerprint"],
+                 "score": entry["base_score"]},
+        "winner": {"plan_fingerprint": entry["winner_fingerprint"],
+                   "score": entry["score"]},
+        "winner_tuned_fields": entry["tuned"],
+        "winner_env": {},
+        "improvement": entry["improvement"],
+        "candidates": [], "pruned": [],
+        "space": entry["space"],
+    }
+    base = plan_for_preset("tiny_fsdp8")
+    cfg = preset_model_cfg("tiny_fsdp8")
+    registry.save_entry(result, base_plan=base, model_cfg=cfg,
+                        directory=fixture_registry)
+    _, fresh = _one_entry(fixture_registry)
+    assert len(fresh["observed"]) == 2
+    assert {r["arm"] for r in fresh["observed"]} == {"base", "tuned"}
+    assert "stale" not in fresh and "drift" not in fresh
+    # a re-tune with a DIFFERENT winner drops the old tuned evidence
+    moved = dict(result,
+                 winner={"plan_fingerprint": "0" * 16,
+                         "score": entry["score"]})
+    registry.save_entry(moved, base_plan=base, model_cfg=cfg,
+                        directory=fixture_registry)
+    _, fresh = _one_entry(fixture_registry)
+    assert {r["arm"] for r in fresh["observed"]} == {"base"}
+
+
+def test_drift_band_knob(monkeypatch):
+    """AUTOTUNE_DRIFT_BAND: config wins over env wins over the
+    default; malformed values degrade to the default, loudly enough
+    to live with."""
+    from gke_ray_train_tpu.autotune.registry import (
+        DRIFT_BAND_DEFAULT, drift_band)
+    monkeypatch.delenv("AUTOTUNE_DRIFT_BAND", raising=False)
+    assert drift_band() == DRIFT_BAND_DEFAULT
+    monkeypatch.setenv("AUTOTUNE_DRIFT_BAND", "0.5")
+    assert drift_band() == 0.5
+    assert drift_band({"AUTOTUNE_DRIFT_BAND": "0.1"}) == 0.1
+    monkeypatch.setenv("AUTOTUNE_DRIFT_BAND", "bogus")
+    assert drift_band() == DRIFT_BAND_DEFAULT
+    assert drift_band({"AUTOTUNE_DRIFT_BAND": -1}) == DRIFT_BAND_DEFAULT
+
+
+def test_ingest_hook_gating(fixture_registry, tmp_path):
+    """_run_worker's attempt-end hook: rank-0 only, AUTOTUNE_INGEST=0
+    opts out, and NOTHING on this path is ever fatal — a broken
+    registry dir degrades to a logged warning."""
+    from gke_ray_train_tpu.rayint.trainer import _maybe_ingest_observed
+
+    class Obs:
+        rank = 0
+        obs_dir = OBS_GOOD
+
+    plan = dataclasses.replace(plan_for_preset("tiny_fsdp8"),
+                               autotune=True)
+    config = {"AUTOTUNE_DIR": fixture_registry}
+    # opt-out plan / non-zero rank / no obs session: nothing written
+    _maybe_ingest_observed(None, plan, config)
+    off = dataclasses.replace(plan, autotune_ingest=False)
+    _maybe_ingest_observed(Obs(), off, config)
+    r1 = Obs()
+    r1.rank = 1
+    _maybe_ingest_observed(r1, plan, config)
+    _, entry = _one_entry(fixture_registry)
+    assert not entry.get("observed")
+    # rank 0 + armed plan: the bench rows (search-time fingerprints)
+    # match without any runtime_arms mapping
+    _maybe_ingest_observed(Obs(), plan, config)
+    _, entry = _one_entry(fixture_registry)
+    assert len(entry["observed"]) == 2
+    # never fatal: an unreadable registry path degrades to a warning
+    _maybe_ingest_observed(Obs(), plan,
+                           {"AUTOTUNE_DIR": str(tmp_path) + "\x00bad"})
+
+
+def test_stale_entry_worker_attempt_completes_untuned(fixture_registry,
+                                                      tmp_path, caplog):
+    """Drift teeth never turn into a crash: a worker whose config says
+    AUTOTUNE=1 against a drift-tripped entry logs the refusal and the
+    attempt runs — and COMPLETES — on the untuned plan."""
+    import shutil
+    from gke_ray_train_tpu.analysis.plancheck import model_config_for
+    from gke_ray_train_tpu.autotune import registry
+    from gke_ray_train_tpu.rayint.trainer import _run_worker
+    obs_doc = str(tmp_path / "obs_doctored")
+    shutil.copytree(OBS_DOCTORED, obs_doc)
+    registry.ingest_observed(OBS_GOOD, directory=fixture_registry)
+    registry.fit_and_save_calibration(fixture_registry)
+    s = registry.ingest_observed(obs_doc, directory=fixture_registry)
+    assert s["drift"]
+    # re-key the stale entry onto the model a SMOKE_TEST config
+    # derives, so the worker's digest lookup HITS it (and then refuses
+    # on staleness, not on a miss)
+    base = plan_for_preset("tiny_fsdp8")
+    config = {**{k: v for k, v in base.to_config().items()
+                 if v is not None},
+              "SMOKE_TEST": 1, "AUTOTUNE": 1,
+              "AUTOTUNE_DIR": fixture_registry}
+    smoke_cfg = model_config_for(config, ExecutionPlan.resolve(config))
+    digest = registry.model_digest(smoke_cfg)
+    path, entry = _one_entry(fixture_registry)
+    key = registry.entry_key(digest, entry["topology"],
+                             entry["surface"])
+    entry["key"] = key
+    entry["model_digest"] = digest
+    entry["model"] = smoke_cfg.to_dict()
+    entry["fingerprint_inputs"]["model_digest"] = digest
+    entry["candidates_file"] = f"{key}.candidates.json"
+    os.remove(path)
+    _rewrite_entry(registry.entry_path(key, fixture_registry), entry)
+
+    def fn(cfg_in):
+        return {"ok": 1.0}
+
+    with caplog.at_level("WARNING"):
+        out = _run_worker(fn, config, {})
+    assert out["metrics"] == {"ok": 1.0}
+    assert out["plan_fingerprint"] == \
+        ExecutionPlan.resolve(config).fingerprint()   # untuned plan
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("REFUSING" in m and "STALE" in m for m in msgs)

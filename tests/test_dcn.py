@@ -93,6 +93,8 @@ def _run_drill(dcn_sync, *, dcn_compress="none", grad_accum=1, steps=4,
 # the bitwise flat-vs-hier drill (+ the manual-overlap compose)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~12s double elastic drill; the flat-vs-hier bitwise
+# contract stays in tier-1 via test_flat_vs_hier_bitwise_under_grad_accum
 def test_flat_vs_hier_bitwise_with_live_dcn_shrink():
     """One drill, three claims: bitwise loss streams, the live compiled
     programs' DCN bytes shrink by ~1/ici_size, and the hier program

@@ -541,14 +541,14 @@ def test_maybe_replan_noop_without_elastic(devices, monkeypatch):
     assert new.chips == 4 and new.resolved_sizes()["fsdp"] == 4
 
 
-def test_ckpt_topology_note_and_reshard_witness(tmp_path, devices):
+def test_ckpt_topology_note_and_reshard_witness(tmp_path, devices, fsdp_mesh):
     from gke_ray_train_tpu.models.transformer import init_params, param_specs
     from gke_ray_train_tpu.parallel.mesh import MeshConfig, build_mesh
     from gke_ray_train_tpu.parallel.sharding import shard_tree
 
     cfg = tiny(d_model=64, n_layers=2, n_heads=2, n_kv_heads=2,
                d_ff=128, vocab_size=256)
-    save_mesh = build_mesh(MeshConfig(data=2, fsdp=4), devices)
+    save_mesh = fsdp_mesh  # session 2 data x 4 fsdp — same shape as before
     params = shard_tree(init_params(cfg, jax.random.key(0)), save_mesh,
                         param_specs(cfg))
     mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=1,

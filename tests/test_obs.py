@@ -124,19 +124,6 @@ def test_configure_run_logging_prefix(capsys):
 # loop integration: bitwise A/B + anomaly-capture drill
 # ---------------------------------------------------------------------------
 
-def _tiny_setup():
-    from gke_ray_train_tpu.models import tiny
-    from gke_ray_train_tpu.train import (
-        make_optimizer, make_train_state, make_train_step)
-    cfg = tiny(vocab_size=128, d_model=32, n_layers=1, n_heads=2,
-               n_kv_heads=2, d_ff=64, dtype="float32",
-               param_dtype="float32")
-    opt = make_optimizer(1e-3)
-    state = make_train_state(cfg, opt, jax.random.key(0))
-    step = make_train_step(cfg, opt, donate=False)
-    return cfg, opt, state, step
-
-
 def _batches(steps, B=2, S=16, vocab=128, hook=None):
     def gen(epoch):
         for i in range(steps):
@@ -149,15 +136,16 @@ def _batches(steps, B=2, S=16, vocab=128, hook=None):
     return gen
 
 
-def test_obs_off_hot_path_bitwise(tmp_path):
+def test_obs_off_hot_path_bitwise(tmp_path, tiny_train_setup):
     """The acceptance gate: the loss stream with obs fully enabled —
     including causal span tracing, which defaults on (TRACE=1) — is
     BITWISE-identical to obs off: telemetry adds no device traffic
-    and perturbs no numerics."""
+    and perturbs no numerics. Both arms start from the SAME shared
+    step-0 state, which is the A/B discipline anyway."""
     from gke_ray_train_tpu.train.loop import run_training
 
     def run(with_obs):
-        _, _, state, step = _tiny_setup()
+        _, _, state, step = tiny_train_setup
         if with_obs:
             obs_runtime.start_attempt(
                 obs_dir=str(tmp_path / "obs_on"))
@@ -185,13 +173,13 @@ def test_obs_off_hot_path_bitwise(tmp_path):
         {s["name"] for s in sps}
 
 
-def test_anomaly_capture_fire_once(tmp_path):
+def test_anomaly_capture_fire_once(tmp_path, tiny_train_setup):
     """The drill the ISSUE names: injected data stall + injected
     mid-run recompile on the CPU mesh; each anomaly class fires
     exactly ONE capture with a real artifact, and a second stall does
     not re-fire."""
     from gke_ray_train_tpu.train.loop import run_training
-    _, _, state, step = _tiny_setup()
+    _, _, state, step = tiny_train_setup
     steps = 26
     STALLS, COMPILE_AT = (12, 18), 22
 
@@ -335,7 +323,8 @@ class _StubWriter:
         self.closed = True
 
 
-def test_tb_flush_on_preempt_and_ledger_scalars(tmp_path):
+def test_tb_flush_on_preempt_and_ledger_scalars(tmp_path,
+                                                tiny_train_setup):
     """The satellite fix: a preempted attempt flushes its scalars
     BEFORE the grace-window save (SIGKILL-proof), and the goodput
     ledger reaches TB from the obs registry — no second computation."""
@@ -345,7 +334,7 @@ def test_tb_flush_on_preempt_and_ledger_scalars(tmp_path):
     from gke_ray_train_tpu.train import preempt
     from gke_ray_train_tpu.train.loop import run_training
     from gke_ray_train_tpu.train.preempt import Preempted
-    _, _, state, step = _tiny_setup()
+    _, _, state, step = tiny_train_setup
     reset_fired()
     preempt.reset()
     w = _StubWriter()
@@ -507,15 +496,26 @@ def _elastic_drill(work):
     return obs_dir, res
 
 
-def test_obs_report_elastic_drill(tmp_path):
+@pytest.fixture(scope="module")
+def elastic_drill(tmp_path_factory):
+    """ONE traced 8->4->8 drill with serve-after-train, shared by the
+    report AND trace/diff acceptance tests — the drill is the
+    expensive part (five compiles across two mesh shapes) and both
+    consumers only READ its artifacts (ISSUE 16 wall satellite)."""
+    work = str(tmp_path_factory.mktemp("obs_elastic_drill"))
+    obs_dir, res = _elastic_drill(work)
+    return work, obs_dir, res
+
+
+def test_obs_report_elastic_drill(elastic_drill):
     """The acceptance drill: a CPU-mesh run with injected pool_shrink
     events produces ONE report in which (a) every attempt's ledger
     terms sum to its wall-clock exactly, (b) both reshards (8->4 and
     4->8) appear on the attempt timelines, and (c) the per-attempt
     events classify shrink/grow as preemptions."""
     from gke_ray_train_tpu.obs.report import build_report
-    obs_dir, res = _elastic_drill(str(tmp_path))
-    rep = build_report(str(tmp_path))       # parent dir also accepted
+    work, obs_dir, res = elastic_drill
+    rep = build_report(work)                # parent dir also accepted
     assert rep["n_attempts"] == res.attempts == 3
     assert rep["reconciled"] is True
     for a in rep["attempts"]:
@@ -537,7 +537,8 @@ def test_obs_report_elastic_drill(tmp_path):
     assert abs(rep["goodput"]["wall_s"] - res.goodput["wall_s"]) < 1e-6
 
 
-def test_terminal_pool_failure_attempt_still_reported(tmp_path):
+def test_terminal_pool_failure_attempt_still_reported(tmp_path,
+                                                     tiny_train_setup):
     """A shrink below MIN_DEVICES ends the run from inside
     classify_pool — the terminal attempt must still get its
     attempt_end BEFORE run_end closes the driver stream, so the
@@ -549,7 +550,7 @@ def test_terminal_pool_failure_attempt_still_reported(tmp_path):
     from gke_ray_train_tpu.testing.faults import (
         FaultInjector, parse_fault_spec, reset_fired, reset_pool)
     from gke_ray_train_tpu.train.loop import run_training
-    _, _, state, step = _tiny_setup()
+    _, _, state, step = tiny_train_setup
     obs_dir = str(tmp_path / "obs")
     config = {"ELASTIC": "1", "MIN_DEVICES": "6",
               "OBS": "1", "OBS_DIR": obs_dir, "OBS_CAPTURE": "0"}
@@ -694,7 +695,8 @@ def test_report_rejects_unreconciled(tmp_path):
     assert r.returncode == 3
 
 
-def test_crashed_attempt_trace_still_reconciles(tmp_path):
+def test_crashed_attempt_trace_still_reconciles(tmp_path,
+                                                 tiny_train_setup):
     """Span/ledger coherence on the EXCEPTION path: a step that dies
     right after the ledger booked a data wait (and an eval that dies
     inside its paused() region) must not leave the span stream short
@@ -703,7 +705,7 @@ def test_crashed_attempt_trace_still_reconciles(tmp_path):
     over a training failure."""
     from gke_ray_train_tpu.obs.report import build_report
     from gke_ray_train_tpu.train.loop import run_training
-    _, _, state, step = _tiny_setup()
+    _, _, state, step = tiny_train_setup
     calls = {"n": 0}
 
     def crashing_step(st, batch):
@@ -729,7 +731,7 @@ def test_crashed_attempt_trace_still_reconciles(tmp_path):
 
     # and the eval twin: paused(ledger) books on __exit__ even when
     # eval raises — the span must be emitted on that path too
-    _, _, state2, step2 = _tiny_setup()
+    _, _, state2, step2 = tiny_train_setup
 
     def bad_eval(st):
         time.sleep(0.03)
@@ -750,7 +752,7 @@ def test_crashed_attempt_trace_still_reconciles(tmp_path):
     assert any(s["name"] == "eval" for s in spans)
 
 
-def test_trace_critical_path_and_diff_on_elastic_drill(tmp_path):
+def test_trace_critical_path_and_diff_on_elastic_drill(elastic_drill):
     """ISSUE 14 acceptance on the existing drill path: the 8->4->8 run
     produces ONE merged trace whose per-attempt critical path
     reconciles exactly with the goodput ledger (CLI rc=0), shows both
@@ -760,7 +762,7 @@ def test_trace_critical_path_and_diff_on_elastic_drill(tmp_path):
     from gke_ray_train_tpu.obs import trace as obs_trace
     from gke_ray_train_tpu.obs.diff import diff_flat, flatten_report
     from gke_ray_train_tpu.obs.report import build_report
-    obs_dir, res = _elastic_drill(str(tmp_path))
+    _, obs_dir, res = elastic_drill
     assert res.metrics.get("served") == 1
 
     spans = list(obs_trace.iter_spans(obs_dir))
@@ -870,3 +872,132 @@ def test_serve_engine_exports_obs(tmp_path):
     assert mx["serve_completed_total"] == 3
     assert mx["serve_batch_occupancy"] > 0
     assert mx["serve_p50_token_latency_s"] >= 0
+    # the workload-shape histogram (ISSUE 16 satellite): one
+    # observation per ADMITTED request — prompt tokens plus the decode
+    # budget, the number capacity planning actually sizes against
+    rl = mx["request_len"]
+    assert rl["count"] == 3
+    assert rl["p50"] == 6 + 4      # len(token_ids) + max_new_tokens
+    assert rl["sum"] == 3 * 10
+
+
+# ---------------------------------------------------------------------------
+# observed-run extraction (ISSUE 16: the obs -> autotune bridge)
+# ---------------------------------------------------------------------------
+
+def test_weighted_median_and_chip_family():
+    from gke_ray_train_tpu.obs.observe import chip_family, weighted_median
+    assert weighted_median([]) is None
+    assert weighted_median([(0.5, 3.0)]) == 0.5
+    # weights count: the heavy window wins even when outnumbered
+    assert weighted_median([(1.0, 1.0), (2.0, 1.0), (3.0, 10.0)]) == 3.0
+    # deterministic crossing: smallest value where cumulative weight
+    # reaches half the total
+    assert weighted_median([(1.0, 1.0), (2.0, 1.0)]) == 1.0
+    assert chip_family("v5e-256") == "v5e"
+    assert chip_family("cpu-8") == "cpu"
+    assert chip_family(None) is None
+
+
+def _synthetic_session(obs_dir, *, backend="cpu", fp="f" * 16):
+    """Hand-written event/span streams shaped like one train attempt
+    that also drained a serve engine — the driverless idiom of the
+    report tests above, pointed at the extraction instead."""
+    from gke_ray_train_tpu.obs.events import EventLog, events_path
+    from gke_ray_train_tpu.obs.trace import SpanLog, spans_path
+    log = EventLog(events_path(obs_dir, 0), run_id="obsrun", attempt=1,
+                   rank=0, plan_fingerprint=fp)
+    log.emit("attempt_start", topology="cpu-8", n_devices=8)
+    if backend:
+        log.emit("first_step", compile_s=1.0, backend=backend)
+    log.emit("serve_drained", replica=0, stats={
+        "completed": 3, "iterations": 12,
+        "p50_token_latency_s": 0.002, "p99_token_latency_s": 0.004})
+    log.emit("worker_exit", status="ok", goodput={
+        "step_s": 6.0, "data_stall_s": 1.0, "wall_s": 10.0})
+    log.close()
+    sp = SpanLog(spans_path(obs_dir, 0), run_id="obsrun", attempt=1,
+                 rank=0)
+    # three windows; the weighted median must shrug off the slow one
+    sp.emit("step_window", 1.0, steps=10, data_stall_s=0.0)  # 0.10/step
+    sp.emit("step_window", 1.2, steps=10, data_stall_s=0.2)  # 0.10/step
+    sp.emit("step_window", 2.0, steps=2, data_stall_s=0.0)   # 1.00/step
+    sp.close()
+
+
+def test_observed_runs_extraction_and_determinism(tmp_path):
+    from gke_ray_train_tpu.obs.observe import observed_runs, row_measure
+    _synthetic_session(str(tmp_path))
+    rows = observed_runs(str(tmp_path))
+    assert [r["surface"] for r in rows] == ["serve", "train"]
+    serve, train = rows
+    assert train["plan_fingerprint"] == "f" * 16
+    assert train["topology"] == "cpu-8" and train["chip_family"] == "cpu"
+    assert train["backend"] == "cpu"
+    # (dur - data_stall) / steps, step-count-weighted median: the
+    # 1.0s/step outlier window (2 steps) must not drag the number
+    assert train["measured_step_s"] == 0.1
+    assert train["steps"] == 22
+    assert train["goodput_frac"] == 0.6
+    assert train["data_stall_frac"] == 0.1
+    assert serve["measured_per_token_s"] == 0.002
+    assert serve["serve_p99_token_latency_s"] == 0.004
+    assert row_measure(train) == 0.1 and row_measure(serve) == 0.002
+    # re-extraction is bitwise-identical — the base of the ingest
+    # idempotency contract (autotune/registry.py)
+    assert json.dumps(rows, sort_keys=True) == \
+        json.dumps(observed_runs(str(tmp_path)), sort_keys=True)
+
+
+def test_observed_backend_never_inferred(tmp_path):
+    """No first_step backend stamp -> backend stays None. The
+    extraction NEVER guesses: ingest refuses None-backend rows, which
+    is the first half of the cpu-fallback-never-calibrates-a-TPU
+    guarantee (the other half is the registry's backend gate)."""
+    from gke_ray_train_tpu.obs.observe import observed_runs
+    _synthetic_session(str(tmp_path), backend=None)
+    rows = observed_runs(str(tmp_path))
+    assert rows and all(r["backend"] is None for r in rows)
+
+
+def test_report_backend_and_autotune_drift_section(tmp_path):
+    """first_step's backend stamp and any autotune_drift events ride
+    the report, render in the text view, and flatten into `obs diff`
+    scalars with teeth (a drift event appearing — or the recorded
+    drift fields VANISHING — trips the gate)."""
+    from gke_ray_train_tpu.obs.diff import diff_flat, flatten_report
+    from gke_ray_train_tpu.obs.events import EventLog, events_path
+    from gke_ray_train_tpu.obs.report import build_report, render_text
+    log = EventLog(events_path(str(tmp_path), 0), run_id="r",
+                   attempt=1, rank=0)
+    log.emit("first_step", compile_s=1.0, backend="cpu-fallback")
+    log.emit("worker_exit", status="ok",
+             goodput={"compile_s": 1.0, "step_s": 3.0, "wall_s": 4.0})
+    log.emit("autotune_drift", key="train-cpu-8-abc", arm="tuned",
+             measured_step_s=0.19, raw_modeled_step_s=0.019,
+             corrected_modeled_step_s=0.038, rel_err=0.8, band=0.25,
+             stale=True)
+    log.close()
+    rep = build_report(str(tmp_path))
+    assert rep["backend"] == "cpu-fallback"
+    at = rep["autotune"]
+    assert at["drift_events"] == 1 and at["drift_stale"] == 1
+    assert at["drift_max_rel_err"] == 0.8 and at["drift_band"] == 0.25
+    assert at["drift_keys"] == ["train-cpu-8-abc"]
+    txt = render_text(rep)
+    assert "backend: cpu-fallback" in txt
+    assert "1 STALE" in txt
+    flat = flatten_report(rep)
+    assert flat["autotune_drift_events"] == 1.0
+    assert flat["autotune_drift_stale"] == 1.0
+    assert flat["autotune_drift_max_rel_err"] == 0.8
+    assert diff_flat(flat, flat) == []
+    # a NEW drift event where the baseline recorded one is exact-gated
+    viols = diff_flat({**flat, "autotune_drift_events": 2.0}, flat)
+    assert any("autotune_drift_events" in v for v in viols)
+    # recorded drift scalars missing from the fresh side = the
+    # telemetry that produced them broke — also a trip
+    clean = {k: v for k, v in flat.items()
+             if not k.startswith("autotune_drift")}
+    viols = diff_flat(clean, flat)
+    assert any("autotune_drift" in v and "MISSING" in v for v in viols)

@@ -217,6 +217,8 @@ def test_checked_in_budgets_pass():
 # fused kernels
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~25s all-preset sweep; the fused-kernel precision
+# contract stays in tier-1 via test_seeded_precision_regression_caught
 def test_fused_kernels_within_pinned_ledger():
     from gke_ray_train_tpu.analysis.kernelcheck import (
         ledger_findings, sweep)
