@@ -294,7 +294,7 @@ def check_serve_preset(name: str, *, budget_dir: Optional[str] = None
     from gke_ray_train_tpu.perf.costs import step_cost_report
 
     findings: List[str] = []
-    compiled, params, state, jitted = build_serve_preset_step(
+    compiled, params, state, jitted, lora_arg = build_serve_preset_step(
         name, with_jitted=True)
 
     report = step_cost_report(compiled)
@@ -313,8 +313,8 @@ def check_serve_preset(name: str, *, budget_dir: Optional[str] = None
                                       label=f"{name} decode_step"))
 
     with RecompileDetector() as det:
-        state1 = jax.block_until_ready(jitted(params, state, None))
-        jax.block_until_ready(jitted(params, state1, None))
+        state1 = jax.block_until_ready(jitted(params, state, lora_arg))
+        jax.block_until_ready(jitted(params, state1, lora_arg))
     findings.extend(det.findings())
     return [f"{name}: {f}" for f in findings]
 

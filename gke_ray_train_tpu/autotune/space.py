@@ -25,8 +25,12 @@ static checkers can prove runnable:
 - **prefetch**: input-pipeline depths. Operational — the cost model is
   indifferent, and the distance-from-base tie-break keeps the declared
   depth unless something else differentiates.
-- serve surface: **max_batch** slot counts and **buckets** request
-  length-bucket lists instead of the train dims.
+- serve surface: **max_batch** slot counts, **buckets** request
+  length-bucket lists (declared arms plus widths fitted to the
+  observed ``request_len`` histogram when the plan has an obs dir),
+  **adapters** pool capacities and **spec_k** speculative draft
+  lengths (only when the base plan speculates) instead of the train
+  dims.
 
 Every candidate is pruned STATICALLY before any compile, reusing the
 checkers the budget suite already trusts: ``ExecutionPlan`` validation
@@ -58,13 +62,14 @@ TUNABLE_FIELDS: Dict[str, Tuple[str, ...]] = {
     "train": ("data", "fsdp", "per_device_batch", "grad_accum",
               "overlap", "dcn_sync", "dcn_compress", "fused_ops",
               "prefetch"),
-    "serve": ("max_batch", "decode_buckets"),
+    "serve": ("max_batch", "decode_buckets", "max_adapters", "spec_k"),
 }
 
 # dimension vocabulary per surface (the --dims CLI filter)
 TRAIN_DIMS: Tuple[str, ...] = ("mesh", "batch", "sync", "fused",
                                "flash", "prefetch")
-SERVE_DIMS: Tuple[str, ...] = ("max_batch", "buckets")
+SERVE_DIMS: Tuple[str, ...] = ("max_batch", "buckets", "adapters",
+                               "spec_k")
 
 # the flash-block sweep grid (the same cells scripts/record_baselines.sh
 # has swept by hand since r4)
@@ -73,6 +78,12 @@ FLASH_BLOCK_GRID: Tuple[Tuple[int, int], ...] = tuple(
 
 PREFETCH_DEPTHS: Tuple[int, ...] = (0, 2, 4)
 MAX_BATCH_ARMS: Tuple[int, ...] = (4, 8, 16)
+# multi-tenant serving arms (ISSUE 17): adapter-pool capacities and
+# speculative draft lengths. spec_k arms only enumerate when the base
+# plan actually speculates (SPEC_DRAFT != none) — with speculation off
+# spec_k never enters a compiled program and every arm is a duplicate
+MAX_ADAPTERS_ARMS: Tuple[int, ...] = (4, 8, 16)
+SPEC_K_ARMS: Tuple[int, ...] = (2, 4, 8)
 
 
 def numel(shape_struct) -> int:
@@ -220,12 +231,53 @@ def _flash_envs(base: ExecutionPlan, model_cfg) -> List[Tuple]:
     return out
 
 
+def _observed_len_buckets(base: ExecutionPlan) -> List[int]:
+    """Bucket widths fitted to OBSERVED traffic: the request_len
+    histogram (prompt + budgeted new tokens, the number the engine's
+    ``pick_bucket`` routes on) exported to ``metrics-r*.json`` under
+    the plan's obs dir. Its p50/p99 rounded up to the 128-token grid
+    are exactly the widths that make the median and the tail request
+    pad least — the histogram closes the loop from a served run back
+    into the search space. Silent when the plan has no obs dir or the
+    dir has no serving telemetry."""
+    import glob
+    import os
+    if not base.obs_dir or not os.path.isdir(base.obs_dir):
+        return []
+    quantiles: List[float] = []
+    for path in sorted(glob.glob(
+            os.path.join(base.obs_dir, "metrics-r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        h = doc.get("request_len")
+        if isinstance(h, dict) and h.get("count"):
+            quantiles += [float(h.get("p50", 0)), float(h.get("p99", 0))]
+    out = []
+    for q in quantiles:
+        if q <= 0:
+            continue
+        width = min(max(128, -(-int(q) // 128) * 128), base.max_seq_len)
+        if width not in out:
+            out.append(width)
+    return sorted(out)
+
+
 def _bucket_options(base: ExecutionPlan) -> List[str]:
     """Serve bucket-list arms: the declared list plus each single
-    bucket (coarser lists = fewer executables, finer = tighter pads)."""
+    bucket (coarser lists = fewer executables, finer = tighter pads),
+    plus the histogram-fit widths from the plan's obs dir — each as a
+    single-bucket arm and, when more than one, the fitted list (p50
+    bucket for the median, p99 bucket for the tail)."""
     buckets = base.bucket_list()
     opts = [",".join(str(b) for b in buckets)]
     opts.extend(str(b) for b in buckets)
+    fitted = _observed_len_buckets(base)
+    opts.extend(str(b) for b in fitted)
+    if len(fitted) > 1:
+        opts.append(",".join(str(b) for b in fitted))
     seen = set()
     return [o for o in opts if not (o in seen or seen.add(o))]
 
@@ -289,11 +341,26 @@ def enumerate_space(base_plan: ExecutionPlan, model_cfg=None, *,
             if "max_batch" in use else [base_plan.max_batch]
         bucket_opts = _bucket_options(base_plan) \
             if "buckets" in use else [base_plan.decode_buckets]
+        ad_opts = sorted({base_plan.max_adapters, *MAX_ADAPTERS_ARMS}) \
+            if "adapters" in use else [base_plan.max_adapters]
+        if "spec_k" in use and base_plan.spec_draft != "none":
+            sk_opts = sorted({base_plan.spec_k, *SPEC_K_ARMS})
+        else:
+            sk_opts = [base_plan.spec_k]
+            if "spec_k" in use:
+                pruned.append(
+                    "spec_k arms: skipped — base SPEC_DRAFT=none "
+                    "(speculation off; every arm would compile the "
+                    "identical program)")
         dim_counts = {"max_batch": len(mb_opts),
-                      "buckets": len(bucket_opts)}
+                      "buckets": len(bucket_opts),
+                      "adapters": len(ad_opts),
+                      "spec_k": len(sk_opts)}
         combos: List[Dict[str, Any]] = [
-            {"max_batch": mb, "decode_buckets": bl}
-            for mb in mb_opts for bl in bucket_opts]
+            {"max_batch": mb, "decode_buckets": bl,
+             "max_adapters": na, "spec_k": sk}
+            for mb in mb_opts for bl in bucket_opts
+            for na in ad_opts for sk in sk_opts]
         env_opts: List[Tuple] = [()]
     else:
         mesh_opts = _mesh_options(base_plan) if "mesh" in use \

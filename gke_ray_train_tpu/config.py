@@ -46,8 +46,11 @@ PLAN_SCOPED_KEYS = frozenset({
     # runtime guards (analysis/guards.py)
     "TRANSFER_GUARD", "RECOMPILE_LIMIT", "DIVERGENCE_GUARD",
     # serving shape (serve/engine.py): slot count, length buckets,
-    # served-weight quantization
+    # served-weight quantization, multi-tenant adapter pool size,
+    # prefix/KV reuse and speculative decoding (ISSUE 17) — all
+    # serve-surface compile-relevant, never train-relevant
     "MAX_BATCH", "DECODE_BUCKETS", "SERVE_QUANT",
+    "MAX_ADAPTERS", "PREFIX_CACHE", "SPEC_DRAFT", "SPEC_K",
     # observability (obs/): unified telemetry on/off + dir, the
     # anomaly-triggered profiler capture policy, and causal span
     # tracing (obs/trace.py — per-rank span streams, critical-path

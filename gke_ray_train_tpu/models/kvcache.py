@@ -108,6 +108,15 @@ def forward_step(params: Params, tokens: jnp.ndarray, cfg: ModelConfig,
     trainer supports (GQA, RoPE/sinusoidal, sliding-window patterns,
     softcaps, QTensor bases, LoRA adapters).
     """
+    if lora is not None and "aslot" in lora:
+        # multi-tenant serving: ``lora`` is {"aslot": [B] int32,
+        # "blocks": stacked pool with adapter axis 1} — gather each
+        # row's adapter ONCE here (not per layer) so the block scan
+        # sees ordinary per-row [B, d_in, r] entries and ``_proj``
+        # takes the batched-einsum path (ops/lora_batched.py)
+        from gke_ray_train_tpu.ops.lora_batched import gather_pool
+        lora = {"blocks": gather_pool(lora["blocks"], lora["aslot"])}
+
     B, T = tokens.shape
     dtype = jnp.dtype(cfg.dtype)
     eps, sp1 = cfg.norm_eps, cfg.norm_scale_plus_one

@@ -199,9 +199,18 @@ def _proj(x, w, lora_p, lora_scale, dtype, drop_rng=None, drop_rate=0.0,
             keep = 1.0 - drop_rate
             mask = jax.random.bernoulli(drop_rng, keep, x.shape)
             xl = jnp.where(mask, x / keep, jnp.zeros((), dtype)).astype(dtype)
-        xa = jnp.einsum("bsd,dr->bsr", xl, lora_p["a"].astype(dtype))
-        y = y + jnp.einsum("bsr,rh->bsh", xa, lora_p["b"].astype(dtype)) \
-            * jnp.asarray(lora_scale, dtype)
+        if lora_p["a"].ndim == 3:
+            # per-row adapters, already gathered from a stacked
+            # multi-tenant pool ([B, d_in, r] / [B, r, d_out]) — the
+            # serving engine's batched multi-LoRA path
+            from gke_ray_train_tpu.ops.lora_batched import bgmv
+            y = y + bgmv(xl, lora_p["a"], lora_p["b"],
+                         scale=lora_scale, dtype=dtype)
+        else:
+            xa = jnp.einsum("bsd,dr->bsr", xl, lora_p["a"].astype(dtype))
+            y = y + jnp.einsum("bsr,rh->bsh", xa,
+                               lora_p["b"].astype(dtype)) \
+                * jnp.asarray(lora_scale, dtype)
     if bias is not None:
         y = y + bias.astype(dtype)
     return y

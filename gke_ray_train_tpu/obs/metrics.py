@@ -81,6 +81,16 @@ METRIC_NAMES: Dict[str, str] = {
     "serve_p50_token_latency_s": "gauge",
     "serve_p99_token_latency_s": "gauge",
     "serve_batch_occupancy": "gauge",
+    # multi-tenant serving (ISSUE 17): adapter-pool residency churn
+    # (serve/adapters.py LRU), host-side prefix/KV reuse, and the
+    # speculative-decode acceptance ledger (proposed draft tokens vs
+    # target-verified accepts — the throughput lever's own telemetry)
+    "serve_adapter_hits_total": "counter",
+    "serve_adapter_misses_total": "counter",
+    "serve_adapter_evictions_total": "counter",
+    "serve_prefix_hits_total": "counter",
+    "serve_spec_proposed_total": "counter",
+    "serve_spec_accepted_total": "counter",
     # admitted request length (prompt + max_new_tokens) at the engine's
     # submit path — the workload-shape distribution bucket-padding and
     # MAX_BATCH tuning decisions are made against
@@ -293,7 +303,13 @@ def export_serve_stats(reg: MetricsRegistry, stats: Dict[str, Any]) -> None:
     latency/occupancy has exactly one computation path)."""
     for src, dst in (("iterations", "serve_iterations_total"),
                      ("refills", "serve_refills_total"),
-                     ("completed", "serve_completed_total")):
+                     ("completed", "serve_completed_total"),
+                     ("adapter_hits", "serve_adapter_hits_total"),
+                     ("adapter_misses", "serve_adapter_misses_total"),
+                     ("adapter_evictions", "serve_adapter_evictions_total"),
+                     ("prefix_hits", "serve_prefix_hits_total"),
+                     ("spec_proposed", "serve_spec_proposed_total"),
+                     ("spec_accepted", "serve_spec_accepted_total")):
         if src in stats:
             c = reg.counter(dst)
             c.value = float(stats[src])

@@ -498,6 +498,66 @@ register(KernelSpec(
 ))
 
 
+# -- batched multi-LoRA matmul (multi-tenant serving, ISSUE 17) -------------
+
+def _lora_batched_inputs(case: KernelCase, key: jax.Array,
+                         B=4, d_in=32, d_out=48, r=4, A=3):
+    T = case.kw().get("T", 1)
+    dt = jnp.dtype(case.dtype)
+    kx, ka, kb, ks = jax.random.split(key, 4)
+    x = (jax.random.normal(kx, (B, T, d_in), jnp.float32) * 0.5).astype(dt)
+    # pools stay fp32 like train/lora.py adapters; slot 0 is the
+    # reserved zero adapter (the no-LoRA tenant, serve/adapters.py)
+    a_pool = (jax.random.normal(ka, (A, d_in, r), jnp.float32)
+              / jnp.sqrt(r)).at[0].set(0.0)
+    b_pool = (jax.random.normal(kb, (A, r, d_out), jnp.float32)
+              * 0.5).at[0].set(0.0)
+    aslot = jax.random.randint(ks, (B,), 0, A, jnp.int32)
+    return (x, a_pool, b_pool, aslot), ()
+
+
+def _lora_batched_kernel(case: KernelCase, mesh, x, a_pool, b_pool, aslot):
+    from gke_ray_train_tpu.ops.lora_batched import lora_batched_matmul
+    # aslot stays TRACED — one compiled decode serves every tenant mix
+    # (the multi-tenant engine's recompile-free contract)
+    fn = jax.jit(lambda *a: lora_batched_matmul(
+        *a, scale=0.5, dtype=case.dtype))
+    return fn(x, a_pool, b_pool, aslot)
+
+
+def _lora_batched_oracle(case: KernelCase, mesh, x, a_pool, b_pool, aslot):
+    """Per-request sequential single-adapter loop — each row alone
+    through transformer._proj's 2-D einsum strings, concatenated. Must
+    match BITWISE: rows are independent and the batched contraction
+    keeps per-row reduction order."""
+    dt = jnp.dtype(case.dtype)
+    rows = []
+    for i in range(x.shape[0]):
+        s = int(aslot[i])
+        xa = jnp.einsum("bsd,dr->bsr", x[i:i + 1].astype(dt),
+                        a_pool[s].astype(dt))
+        rows.append(jnp.einsum("bsr,rh->bsh", xa, b_pool[s].astype(dt))
+                    * jnp.asarray(0.5, dt))
+    return jnp.concatenate(rows, axis=0)
+
+
+register(KernelSpec(
+    name="lora_batched",
+    # serving is forward-only: value-only contract (grads=False), no
+    # backward tolerance to pin
+    cases=(
+        KernelCase("decode_f32", grads=False, exact=True),
+        KernelCase("prefill_f32", grads=False, exact=True,
+                   kwargs=(("T", 8),)),
+        KernelCase("decode_bf16", dtype="bfloat16", grads=False,
+                   exact=True),
+    ),
+    build=_lora_batched_inputs,
+    kernel=_lora_batched_kernel,
+    oracle=_lora_batched_oracle,
+))
+
+
 # -- fused epilogue kernels (plan knob FUSED_OPS) ---------------------------
 
 def _fnr_inputs(case: KernelCase, key: jax.Array, B=2, S=128, H=4, K=2,

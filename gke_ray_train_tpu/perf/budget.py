@@ -56,6 +56,13 @@ DEFAULT_TOLERANCES = {
     # gate (a pinned 0 stays exactly 0 on single-slice presets)
     "ici_bytes": 0.25,
     "dcn_bytes": 0.10,
+    # serve-preset modeled latency/throughput (serve_modeled_fields):
+    # deterministic functions of the compile analyses + the declared
+    # ChipSpec, so the same relative band as flops applies — a decode
+    # step that got 10% more expensive moves p50 by the same 10%
+    "serve_tenant_p50_s": 0.05,
+    "serve_tenant_p99_s": 0.05,
+    "serve_tokens_per_s_per_chip": 0.05,
 }
 
 BUDGET_DIR = os.path.join(
@@ -201,10 +208,21 @@ class ServePreset:
     max_batch: int = 8
     bucket: int = 128
     quant: str = "none"
+    # multi-tenant shape (ISSUE 17): n_adapters > 0 budgets the POOLED
+    # decode — the batched-LoRA gather+BGMV path over an AdapterPool of
+    # n_adapters tenant slots (+ the reserved zero slot), the one
+    # executable every mixed-tenant batch shares
+    n_adapters: int = 0
+    lora_r: int = 4
 
 
 SERVE_PRESETS = {
     "serve_tiny8": ServePreset("serve_tiny8"),
+    # the multi-tenant arm: same model/bucket as serve_tiny8, decode
+    # compiled WITH the stacked adapter pool — the flops/bytes delta
+    # between the two JSONs is the recorded cost of multi-LoRA, and the
+    # zero-collective pin still holds (the gather is mesh-local)
+    "serve_multilora8": ServePreset("serve_multilora8", n_adapters=8),
 }
 
 
@@ -232,7 +250,7 @@ def plan_for_serve_preset(preset: Union[str, ServePreset]):
     return ExecutionPlan.from_kwargs(
         data=1, fsdp=1, max_seq_len=p.bucket,
         max_batch=p.max_batch, decode_buckets=str(p.bucket),
-        serve_quant=p.quant,
+        serve_quant=p.quant, max_adapters=(p.n_adapters or 8),
         donate_state=False, donate_batch=False, prefetch=0,
         compile_cache=False, aot_train_step=False,
         topology="cpu-8", budget_preset=p.name)
@@ -243,7 +261,9 @@ def build_serve_preset_step(preset: Union[str, ServePreset], *,
     """(compiled_decode, params, serve_state) for a serve preset — the
     deterministic decode compile whose StepCostReport the budget pins.
     ``with_jitted`` additionally returns the jitted (un-AOT) decode fn
-    for the analysis compile-once probe."""
+    and the lora argument it was lowered with (the stacked pool blocks
+    on a multi-adapter preset, else None) for the analysis
+    compile-once probe."""
     import jax
 
     from gke_ray_train_tpu.models import init_params
@@ -255,12 +275,97 @@ def build_serve_preset_step(preset: Union[str, ServePreset], *,
     cfg = _serve_model_cfg(p)
     params = quantize_for_serving(init_params(cfg, jax.random.key(0)),
                                   p.quant)
+    if p.n_adapters:
+        # pooled decode: the state carries per-slot adapter indices and
+        # the lora argument is the stacked pool — the ONE executable a
+        # mixed-tenant batch runs regardless of which tenants are in it
+        from gke_ray_train_tpu.serve.adapters import AdapterPool
+        from gke_ray_train_tpu.train.lora import LoraConfig, init_lora
+        template = init_lora(cfg, LoraConfig(r=p.lora_r),
+                             jax.random.key(1))
+        pool = AdapterPool(template, max_adapters=p.n_adapters)
+        state = init_serve_state(cfg, p.max_batch, p.bucket,
+                                 multi_lora=True)
+        jitted = jax.jit(make_decode_fn(cfg, eos_ids=(), pool=True),
+                         donate_argnums=(1,))
+        compiled = jitted.lower(params, state, pool.blocks).compile()
+        if with_jitted:
+            return compiled, params, state, jitted, pool.blocks
+        return compiled, params, state
     state = init_serve_state(cfg, p.max_batch, p.bucket)
     jitted = jax.jit(make_decode_fn(cfg, eos_ids=()), donate_argnums=(1,))
     compiled = jitted.lower(params, state, None).compile()
     if with_jitted:
-        return compiled, params, state, jitted
+        return compiled, params, state, jitted, None
     return compiled, params, state
+
+
+def serve_modeled_fields(preset: Union[str, ServePreset],
+                         decode_report: StepCostReport
+                         ) -> Dict[str, float]:
+    """Modeled per-tenant latency/throughput for a serve preset —
+    deterministic functions of the compile analyses, so they gate in CI
+    with no wall clock (the ``autotune/score.py`` roofline model at the
+    plan's declared ChipSpec):
+
+    - ``serve_tenant_p50_s``: one decode iteration — the steady-state
+      per-token latency every resident tenant sees (continuous batching
+      emits one token per slot per iteration);
+    - ``serve_tenant_p99_s``: decode iteration + one full-bucket
+      prefill — the tail where a token waits behind a refill admission
+      stalling the shared batch;
+    - ``serve_tokens_per_s_per_chip``: max_batch tokens per modeled
+      iteration, over the plan's chip count.
+    """
+    from gke_ray_train_tpu.autotune.score import (
+        chip_for_plan, modeled_step_time)
+
+    p = SERVE_PRESETS[preset] if isinstance(preset, str) else preset
+    plan = plan_for_serve_preset(p)
+    chip = chip_for_plan(plan)
+    t_decode = modeled_step_time(decode_report, chip)["modeled_step_s"]
+    t_prefill = modeled_step_time(_serve_prefill_report(p),
+                                  chip)["modeled_step_s"]
+    return {
+        "serve_tenant_p50_s": t_decode,
+        "serve_tenant_p99_s": t_decode + t_prefill,
+        "serve_tokens_per_s_per_chip":
+            p.max_batch / t_decode / max(plan.chips, 1),
+    }
+
+
+def _serve_prefill_report(p: ServePreset) -> StepCostReport:
+    """Cost report of the preset's [1, bucket] prefill — the refill
+    executable whose modeled time is the p99 stall term."""
+    import jax
+    import jax.numpy as jnp
+
+    from gke_ray_train_tpu.models import init_params
+    from gke_ray_train_tpu.ops.quant import quantize_for_serving
+    from gke_ray_train_tpu.serve.engine import make_prefill_fn
+
+    cfg = _serve_model_cfg(p)
+    params = quantize_for_serving(init_params(cfg, jax.random.key(0)),
+                                  p.quant)
+    prompt = jnp.zeros((1, p.bucket), jnp.int32)
+    plen = jnp.ones((1,), jnp.int32)
+    compiled = jax.jit(make_prefill_fn(cfg)).lower(
+        params, prompt, plen, None).compile()
+    return step_cost_report(compiled, tokens_per_step=p.bucket)
+
+
+def build_budget_doc(preset: Union[str, Preset, ServePreset],
+                     *, remat=None) -> Dict[str, Any]:
+    """The full dict a budget records/checks: the StepCostReport plus,
+    on serve presets, the modeled per-tenant fields — the one builder
+    the CLI and the tier-1 budget tests share, so the recorded and the
+    checked documents can never diverge in shape."""
+    report = build_preset_report(preset, remat=remat)
+    doc = report.to_dict()
+    name = preset if isinstance(preset, str) else preset.name
+    if isinstance(preset, ServePreset) or name in SERVE_PRESETS:
+        doc.update(serve_modeled_fields(preset, report))
+    return doc
 
 
 def preset_model_cfg(preset: Union[str, Preset, ServePreset]):
@@ -433,7 +538,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     rc = 0
     for name in names:
         plan = plan_for_preset(name)
-        report = build_preset_report(name)
+        report = build_budget_doc(name)
         path = budget_path(name, args.dir)
         if args.command == "record":
             write_budget(report, path, preset=name, plan=plan)

@@ -74,6 +74,11 @@ OVERLAP_MODES = ("off", "xla", "manual")
 DCN_SYNC_MODES = ("flat", "hier")
 DCN_COMPRESS_MODES = ("none", "bf16")
 
+# speculative-decoding draft sources for the serving engine
+# (serve/engine.py): "self" drafts with the target model itself (the
+# accept-all arm), "distilled" expects a separate small draft model
+SPEC_DRAFT_MODES = ("none", "self", "distilled")
+
 # the compiler flags overlap="xla" applies on a TPU compile surface:
 # XLA's latency-hiding scheduler converts the FSDP all-gathers /
 # grad reduces into async start/done pairs and schedules independent
@@ -191,6 +196,26 @@ class ExecutionPlan:
     decode_buckets: str = "256,512"
     # weight quantization the replica serves: "none" | "int8" | "nf4"
     serve_quant: str = "none"
+    # multi-tenant adapter slots (serve/adapters.py): the stacked LoRA
+    # pool the decode executable compiles against holds max_adapters
+    # tenant slots PLUS the reserved zero adapter at slot 0 (= base
+    # model), so the pool's leading axis — and with it every serve
+    # executable built in pool mode — is shaped by this knob
+    max_adapters: int = 8
+    # host-side prefix/KV reuse: an identical (bucket, adapter, prompt)
+    # re-submission reuses the first request's prefilled KV row + first
+    # token through the insert executable instead of re-prefilling.
+    # The executable SET is unchanged, but the knob is pinned to the
+    # serve compile surface with its siblings so a reuse A/B never
+    # shares a sidecar record ambiguously (ISSUE 17 contract).
+    prefix_cache: bool = False
+    # speculative decoding: "none" (off) | "self" (the target model
+    # drafts for itself — the accept-all drill/bench arm) | "distilled"
+    # (a separate small draft model handed to the engine). spec_k =
+    # draft tokens proposed per round; the fused draft+verify
+    # executable compiles its verify forward at [max_batch, spec_k+1].
+    spec_draft: str = "none"
+    spec_k: int = 4
 
     # -- observability (obs/) -------------------------------------------
     # unified run telemetry: structured events + metric exports into
@@ -280,7 +305,7 @@ class ExecutionPlan:
         if self.num_slices < 1:
             raise PlanError(f"num_slices={self.num_slices} must be >= 1")
         for field in ("per_device_batch", "grad_accum", "max_seq_len",
-                      "pipe_virtual_stages"):
+                      "pipe_virtual_stages", "max_adapters", "spec_k"):
             if getattr(self, field) < 1:
                 raise PlanError(f"{field}={getattr(self, field)} must "
                                 "be >= 1")
@@ -299,6 +324,9 @@ class ExecutionPlan:
         if self.serve_quant not in _serve_quant_kinds():
             raise PlanError(f"serve_quant={self.serve_quant!r} not in "
                             f"{_serve_quant_kinds()}")
+        if self.spec_draft not in SPEC_DRAFT_MODES:
+            raise PlanError(f"spec_draft={self.spec_draft!r} not in "
+                            f"{SPEC_DRAFT_MODES}")
         if self.overlap not in OVERLAP_MODES:
             raise PlanError(f"overlap={self.overlap!r} not in "
                             f"{OVERLAP_MODES}")
@@ -713,6 +741,10 @@ CONFIG_KEYS: Dict[str, str] = {
     "max_batch": "MAX_BATCH",
     "decode_buckets": "DECODE_BUCKETS",
     "serve_quant": "SERVE_QUANT",
+    "max_adapters": "MAX_ADAPTERS",
+    "prefix_cache": "PREFIX_CACHE",
+    "spec_draft": "SPEC_DRAFT",
+    "spec_k": "SPEC_K",
     "obs": "OBS",
     "obs_dir": "OBS_DIR",
     "obs_capture": "OBS_CAPTURE",
@@ -755,7 +787,13 @@ _TRAIN_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
     # serve sidecars — pinned by test like the OBS exclusion twin)
     "overlap", "fused_ops", "dcn_sync", "dcn_compress")
 _SERVE_ONLY_COMPILE_FIELDS: Tuple[str, ...] = (
-    "max_batch", "decode_buckets", "serve_quant")
+    "max_batch", "decode_buckets", "serve_quant",
+    # multi-tenant + speculative serving (ISSUE 17): max_adapters
+    # shapes the stacked adapter pool's leading axis, spec_draft/spec_k
+    # shape the fused draft+verify executable, and prefix_cache rides
+    # the serve surface with them — all serve-only, so retuning any of
+    # them can never stale a TRAIN sidecar
+    "max_adapters", "prefix_cache", "spec_draft", "spec_k")
 COMPILE_RELEVANT_FIELDS: Tuple[str, ...] = (
     _MESH_COMPILE_FIELDS + _TRAIN_ONLY_COMPILE_FIELDS
     + _SERVE_ONLY_COMPILE_FIELDS)
@@ -899,13 +937,14 @@ _BOOL_FIELDS = frozenset({"packing", "donate_state", "donate_batch",
                           "compile_cache", "aot_train_step",
                           "divergence_guard", "obs", "obs_capture",
                           "trace", "fused_ops", "autotune",
-                          "autotune_ingest"})
+                          "autotune_ingest", "prefix_cache"})
 _INT_FIELDS = frozenset({"data", "fsdp", "model", "context", "pipe",
                          "num_slices", "pipe_microbatches",
                          "pipe_virtual_stages", "per_device_batch",
                          "grad_accum", "max_seq_len", "prefetch",
                          "recompile_limit", "max_batch",
-                         "obs_capture_budget"})
+                         "obs_capture_budget", "max_adapters",
+                         "spec_k"})
 
 
 def _coerce(field: str, value: Any) -> Any:
@@ -938,8 +977,11 @@ def _coerce(field: str, value: Any) -> Any:
             raise PlanError(f"decode_buckets={value!r} is not a "
                             "comma-separated int list") from None
         return ",".join(str(v) for v in vals)
-    if field == "serve_quant":
-        return str(value).strip().lower() or "none"
+    if field in ("serve_quant", "spec_draft"):
+        # "", "0", "false" and "off" all spell the disabled arm — the
+        # env dialect needs a disabling spelling (`env SPEC_DRAFT=`)
+        v = str(value).strip().lower()
+        return "none" if v in ("", "0", "false", "no", "off") else v
     if field == "overlap":
         # "", "0" and "false" all mean the plain scan — the env dialect
         # needs a disabling spelling (`env OVERLAP= python ...`)

@@ -55,6 +55,7 @@ def _completion_payload(c) -> Dict[str, Any]:
         "finish_reason": c.finish_reason,
         "first_token_s": float(c.first_token_s),
         "done_s": float(c.done_s),
+        "adapter_id": c.adapter_id,
     }
 
 
@@ -102,12 +103,13 @@ class ServeReplica:
     def serve(self, requests: Sequence[Dict[str, Any]]
               ) -> List[Dict[str, Any]]:
         """Continuously batch ``requests`` (dicts: rid / token_ids /
-        max_new_tokens) to completion; returns completion payloads in
-        submit order."""
+        max_new_tokens / optional adapter_id for multi-tenant engines)
+        to completion; returns completion payloads in submit order."""
         from gke_ray_train_tpu.serve.engine import Request
         reqs = [Request(rid=str(r["rid"]),
                         token_ids=np.asarray(r["token_ids"], np.int32),
-                        max_new_tokens=int(r.get("max_new_tokens", 32)))
+                        max_new_tokens=int(r.get("max_new_tokens", 32)),
+                        adapter_id=r.get("adapter_id"))
                 for r in requests]
         return [_completion_payload(c)
                 for c in self._engine.run_until_drained(reqs)]

@@ -8,6 +8,8 @@ KV-cache pool, adapted to XLA's static-shape world with fixed
 ``(max_batch, bucket)`` executables instead of dynamic pages.
 """
 
+from gke_ray_train_tpu.serve.adapters import (  # noqa: F401
+    AdapterPool, AdapterPoolPinned, adapter_from_checkpoint)
 from gke_ray_train_tpu.serve.bucketing import (  # noqa: F401
     form_prompt_buffer, pick_bucket, prompt_bucket, truncate_prompt)
 from gke_ray_train_tpu.serve.engine import (  # noqa: F401

@@ -1,0 +1,201 @@
+"""Bounded multi-tenant adapter pool (ISSUE 17 — the S-LoRA cache).
+
+The QLoRA fine-tune is an *adapter factory*: production traffic is many
+LoRA tenants over one frozen base. This module owns the device-resident
+half of that: one stacked array per LoRA target matmul
+(``[n_repeats, A, d_in, r]`` / ``[n_repeats, A, r, d_out]``, adapter
+axis 1 — the layout ``ops/lora_batched.py`` gathers from inside the
+shared decode executable), with host-side LRU admission/eviction over
+``MAX_ADAPTERS`` tenant slots. Mirrors the KV-pool discipline
+(serve/engine.py): the pool's SHAPE is fixed at construction so the
+compiled decode never changes; tenants churn by overwriting slots.
+
+Slot 0 is the reserved zero adapter (A = B = 0): a request without an
+``adapter_id`` routes there and gets the exact base-model output —
+adding an exact-zero delta cannot change an argmax, so the no-adapter
+tenant stays bitwise the no-LoRA engine.
+
+Pinning: the engine ``acquire``s a tenant at admission and
+``release``s at retirement; eviction only ever takes an *unpinned*
+slot, so a tenant's weights are never overwritten while one of its
+requests is mid-decode in the shared batch.
+
+Counters (hits/misses/evictions) flow through the engine's ``stats()``
+into the obs metrics registry (``serve_adapter_*_total``).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger(__name__)
+
+
+class AdapterPoolPinned(RuntimeError):
+    """Every resident slot is pinned by an in-flight request — the
+    admission path treats this as 'retry next iteration', not a crash."""
+
+
+def _stack_template(template: Any, n_slots: int) -> Any:
+    """Zeroed pool shaped like ``n_slots`` copies of a single-adapter
+    tree, stacked at axis 1 (adapter axis; the scanned-block axis stays
+    leading — the lora_batched layout contract)."""
+    def widen(leaf):
+        return jnp.zeros(leaf.shape[:1] + (n_slots,) + leaf.shape[1:],
+                         leaf.dtype)
+    return jax.tree.map(widen, template)
+
+
+class AdapterPool:
+    """Bounded host+device adapter pool with LRU eviction.
+
+    ``loader(adapter_id) -> single-adapter tree`` backfills misses
+    (e.g. :func:`adapter_from_checkpoint`); without one, an unknown id
+    raises ``KeyError``. ``template`` is any single-adapter tree of the
+    right shape (e.g. the just-trained ``state.lora``, or
+    ``train.lora.init_lora`` output) — only its shapes/dtypes are read.
+    """
+
+    def __init__(self, template: Any, *, max_adapters: int,
+                 loader: Optional[Callable[[str], Any]] = None):
+        if max_adapters < 1:
+            raise ValueError(f"max_adapters={max_adapters} must be >= 1")
+        self.max_adapters = int(max_adapters)
+        self.n_slots = self.max_adapters + 1   # + reserved zero slot 0
+        tpl = {"blocks": template["blocks"]}
+        # device pool; slot 0 stays all-zero forever (the base tenant)
+        self.blocks = _stack_template(tpl, self.n_slots)["blocks"]
+        self._loader = loader
+        self._slots: "OrderedDict[str, int]" = OrderedDict()  # LRU order
+        self._free = list(range(1, self.n_slots))
+        self._pins: Dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @classmethod
+    def from_template(cls, template: Any, *, max_adapters: int,
+                      loader: Optional[Callable[[str], Any]] = None
+                      ) -> "AdapterPool":
+        return cls(template, max_adapters=max_adapters, loader=loader)
+
+    # -- residency -----------------------------------------------------
+
+    def __contains__(self, adapter_id: str) -> bool:
+        return adapter_id in self._slots
+
+    def resident(self) -> Dict[str, int]:
+        """adapter_id -> slot, LRU-oldest first (inspection/tests)."""
+        return dict(self._slots)
+
+    def slot_of(self, adapter_id: Optional[str]) -> Optional[int]:
+        if adapter_id is None:
+            return 0
+        return self._slots.get(adapter_id)
+
+    def _write(self, slot: int, tree: Any) -> None:
+        """Admission-path pool write (one ``.at[:, slot].set`` per leaf
+        — never inside the decode loop). Shape/structure mismatches
+        (wrong rank r, wrong targets) fail loudly here."""
+        self.blocks = jax.tree.map(
+            lambda p, leaf: p.at[:, slot].set(leaf.astype(p.dtype)),
+            self.blocks, tree["blocks"])
+
+    def register(self, adapter_id: str, tree: Any) -> int:
+        """Make ``adapter_id`` resident with the given single-adapter
+        tree. Ids are immutable-by-contract (the engine's prefix cache
+        keys on them) — re-registering an id raises."""
+        if not adapter_id:
+            raise ValueError("adapter_id must be a non-empty string")
+        if adapter_id in self._slots:
+            raise ValueError(
+                f"adapter {adapter_id!r} already resident — adapter ids "
+                "are immutable (the prefix cache keys on them); use a "
+                "new id for new weights")
+        slot = self._take_slot()
+        self._write(slot, tree)
+        self._slots[adapter_id] = slot
+        return slot
+
+    def _take_slot(self) -> int:
+        if self._free:
+            return self._free.pop(0)
+        for aid in self._slots:            # LRU-oldest first
+            if not self._pins.get(aid):
+                slot = self._slots.pop(aid)
+                self.evictions += 1
+                logger.info("adapter pool: evicted %r from slot %d",
+                            aid, slot)
+                return slot
+        raise AdapterPoolPinned(
+            f"all {self.max_adapters} adapter slots are pinned by "
+            "in-flight requests — raise MAX_ADAPTERS or drain first")
+
+    def acquire(self, adapter_id: Optional[str]) -> int:
+        """Resolve a request's adapter to its pool slot, pinning it for
+        the request's lifetime (engine calls at admission; pair with
+        :meth:`release` at retirement). ``None`` -> the zero slot,
+        never pinned, never evicted."""
+        if adapter_id is None:
+            return 0
+        slot = self._slots.get(adapter_id)
+        if slot is not None:
+            self.hits += 1
+            self._slots.move_to_end(adapter_id)
+        else:
+            if self._loader is None:
+                raise KeyError(
+                    f"adapter {adapter_id!r} is not resident and the "
+                    "pool has no loader")
+            if not self._free and not any(
+                    not self._pins.get(a) for a in self._slots):
+                # raise BEFORE paying the loader: the engine retries a
+                # pinned-out admission every step, and a retry that
+                # loads nothing must not re-read a checkpoint or count
+                # as a miss
+                raise AdapterPoolPinned(
+                    f"all {self.max_adapters} adapter slots are pinned "
+                    "by in-flight requests — raise MAX_ADAPTERS or "
+                    "drain first")
+            slot = self.register(adapter_id, self._loader(adapter_id))
+            self.misses += 1
+        self._pins[adapter_id] = self._pins.get(adapter_id, 0) + 1
+        return slot
+
+    def release(self, adapter_id: Optional[str]) -> None:
+        if adapter_id is None:
+            return
+        n = self._pins.get(adapter_id, 0)
+        if n <= 1:
+            self._pins.pop(adapter_id, None)
+        else:
+            self._pins[adapter_id] = n - 1
+
+    def stats(self) -> Dict[str, Any]:
+        return {"adapter_hits": self.hits,
+                "adapter_misses": self.misses,
+                "adapter_evictions": self.evictions,
+                "adapter_resident": len(self._slots)}
+
+
+def adapter_from_checkpoint(directory: str, step: Optional[int] = None
+                            ) -> Any:
+    """Load a trained adapter tree from a TrainState checkpoint — the
+    existing artifact path (``ckpt/manager.py``): the trainer saves the
+    full state (params/opt/lora) and ``restore_raw`` reads it back
+    topology-free, so a serving host with a different mesh (or no mesh)
+    can still hydrate tenants. Use as an :class:`AdapterPool` loader:
+    ``loader=lambda aid: adapter_from_checkpoint(dirs[aid])``."""
+    from gke_ray_train_tpu.ckpt.manager import CheckpointManager
+    raw = CheckpointManager(directory).restore_raw(step)
+    lora = raw.get("lora") if isinstance(raw, dict) else None
+    if lora is None:
+        raise ValueError(
+            f"checkpoint at {directory} has no 'lora' subtree — was the "
+            "run trained with USE_LORA/USE_QLORA?")
+    return lora

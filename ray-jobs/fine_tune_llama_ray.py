@@ -547,6 +547,12 @@ def train_loop_per_worker(config: dict):
                 state.params, cfg, plan, prompts, eos_ids=eos,
                 lora=state.lora if use_lora else None,
                 lora_scale=lora_cfg.scale if use_lora else 1.0,
+                # LoRA runs tag every smoke request with the trained
+                # adapter's id, so the smoke exercises the multi-tenant
+                # batched-adapter decode path end to end (ISSUE 17) —
+                # serve_smoke.json then records the adapter counters
+                adapter_ids=(["tuned"] * len(prompts) if use_lora
+                             else None),
                 max_new_tokens=64)
             if out is not None and ctx.is_host0():
                 comps, stats = out

@@ -215,7 +215,10 @@ def train_loop_per_worker(config: dict):
                            "skipping")
         else:
             from gke_ray_train_tpu.serve import post_train_smoke
-            # a few sliding-window prefixes of the training corpus
+            # a few sliding-window prefixes of the training corpus;
+            # no adapter_ids — pretraining trains the FULL weights, so
+            # there is no adapter to tag (the fine-tune entry tags its
+            # smoke with the trained LoRA and serves via AdapterPool)
             prompts = [ids[i * 257:i * 257 + 48] for i in range(4)]
             out = post_train_smoke(state.params, cfg, plan, prompts,
                                    max_new_tokens=48)

@@ -36,7 +36,12 @@ run decode         env BENCH_MODE=decode python bench.py
 
 # continuous-batching serving A/B (serve/engine.py): engine across
 # MAX_BATCH slots vs serial batch-1 greedy over the same request set,
-# + p50/p99 per-token latency, batch occupancy, decode StepCostReport
+# + p50/p99 per-token latency, batch occupancy, decode StepCostReport.
+# The same run records the multi-tenant arm (mixed batched-LoRA batch
+# vs per-adapter serial engines — bitwise, recompile-free, >=1.3x
+# asserted, pool hit/miss/evict counters) and the speculative arm
+# (self-draft SPEC_K=4 vs plain — bitwise, iteration reduction +
+# acceptance rate)
 run serve          env BENCH_MODE=serve python bench.py
 
 # overlap execution path A/B (train/overlap.py, plan knob OVERLAP):

@@ -93,13 +93,66 @@ def test_every_serve_candidate_statically_valid():
     base = plan_for_preset("serve_tiny8")
     cfg = preset_model_cfg("serve_tiny8")
     space = enumerate_space(base, cfg, surface="serve")
-    assert len(space) > 1 and not space.pruned
+    assert len(space) > 1
+    # the ONLY acceptable prune on this base is the spec_k ledger note:
+    # speculation is off, so every spec_k arm would compile the
+    # identical program — enumerating them would be wasted compiles
+    assert [p for p in space.pruned if "spec_k" not in p] == []
+    assert space.pruned and "SPEC_DRAFT=none" in space.pruned[0]
+    assert set(space.dims) == {"max_batch", "buckets", "adapters",
+                               "spec_k"}
+    assert space.dims["adapters"] >= 3 and space.dims["spec_k"] == 1
     for cand in space.candidates:
         assert cand.plan.bucket_list()           # validates
         assert cand.plan.max_batch >= 1
         # the train surface's fields are untouched on serve candidates
         for f in TUNABLE_FIELDS["train"]:
             assert getattr(cand.plan, f) == getattr(base, f), cand
+
+
+def test_serve_space_spec_k_arms_gated_on_draft():
+    """spec_k arms enumerate ONLY when the base plan speculates: with
+    SPEC_DRAFT=self the arms appear (and the ledger note disappears);
+    adapter-count arms are always on for the serve surface."""
+    import dataclasses as dc
+    base = dc.replace(plan_for_preset("serve_tiny8"),
+                      spec_draft="self", spec_k=4)
+    cfg = preset_model_cfg("serve_tiny8")
+    space = enumerate_space(base, cfg, surface="serve")
+    assert not space.pruned
+    assert space.dims["spec_k"] >= 3
+    sks = {c.plan.spec_k for c in space.candidates}
+    assert {2, 4, 8} <= sks
+    ads = {c.plan.max_adapters for c in space.candidates}
+    assert {4, 8, 16} <= ads
+
+
+def test_decode_buckets_fitted_from_observed_histogram(tmp_path):
+    """The obs -> autotune satellite: a served run's request_len
+    histogram (p50/p99 of prompt + decode budget) yields bucket arms
+    rounded UP to the 128-token grid and capped at max_seq_len — the
+    widths that pad the median and tail request least."""
+    import dataclasses as dc
+    import json as _json
+    from gke_ray_train_tpu.autotune.space import _bucket_options
+    (tmp_path / "metrics-r0.json").write_text(_json.dumps({
+        "labels": {},
+        "request_len": {"count": 40, "sum": 8000.0,
+                        "p50": 180.0, "p99": 430.0}}))
+    base = dc.replace(plan_for_preset("serve_tiny8"),
+                      obs_dir=str(tmp_path), max_seq_len=512)
+    opts = _bucket_options(base)
+    # 180 -> 256, 430 -> 512 (capped at max_seq_len=512), plus the
+    # fitted two-bucket list covering median AND tail
+    assert "256" in opts and "512" in opts and "256,512" in opts
+    cfg = preset_model_cfg("serve_tiny8")
+    space = enumerate_space(base, cfg, surface="serve")
+    fitted = [c for c in space.candidates
+              if c.plan.decode_buckets == "256,512"]
+    assert fitted, [c.plan.decode_buckets for c in space.candidates]
+    # no telemetry -> no fitted arms, silently (the dims count shrinks)
+    bare = dc.replace(base, obs_dir=None)
+    assert "256,512" not in _bucket_options(bare)
 
 
 def test_enumeration_deterministic_and_deduped():

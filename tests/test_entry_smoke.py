@@ -65,6 +65,12 @@ def test_entry_branches_run_and_learn_shape(tmp_path, over,
         import json
         stats = json.load(open(smoke))
         assert stats["generated_tokens"] > 0 and stats["completed"] > 0
+        # the LoRA run tags its smoke requests with the trained
+        # adapter, so the smoke decoded through a real AdapterPool —
+        # the batched multi-tenant path, not the single-lora fallback
+        assert stats["adapter_requests"] == stats["completed"]
+        assert stats["adapter_hits"] + stats["adapter_misses"] > 0
+        assert stats["adapter_evictions"] == 0
     assert metrics and "loss" in metrics, metrics
     assert metrics["loss"] > 0 and metrics["loss"] < 50
     assert "eval_loss" in metrics

@@ -881,6 +881,35 @@ def test_serve_engine_exports_obs(tmp_path):
     assert rl["sum"] == 3 * 10
 
 
+def test_serve_multitenant_counters_export():
+    """ISSUE 17: the feature-gated stats() keys (adapter pool, prefix
+    cache, speculation) map onto the six serve_* counters — and a
+    plain engine's stats, which LACK those keys, must leave the
+    counters unregistered rather than exporting misleading zeros for
+    features that are off."""
+    from gke_ray_train_tpu.obs.metrics import (
+        MetricsRegistry, export_serve_stats)
+    base = {"iterations": 4, "refills": 0, "completed": 2,
+            "batch_occupancy": 0.5, "p50_token_latency_s": 0.001,
+            "p99_token_latency_s": 0.002}
+    reg = MetricsRegistry()
+    export_serve_stats(reg, dict(base))
+    snap = reg.snapshot()
+    for name in ("serve_adapter_hits_total", "serve_prefix_hits_total",
+                 "serve_spec_proposed_total"):
+        assert name not in snap, name
+    export_serve_stats(reg, dict(
+        base, adapter_hits=3, adapter_misses=2, adapter_evictions=1,
+        prefix_hits=2, spec_proposed=12, spec_accepted=7))
+    snap = reg.snapshot()
+    assert snap["serve_adapter_hits_total"] == 3
+    assert snap["serve_adapter_misses_total"] == 2
+    assert snap["serve_adapter_evictions_total"] == 1
+    assert snap["serve_prefix_hits_total"] == 2
+    assert snap["serve_spec_proposed_total"] == 12
+    assert snap["serve_spec_accepted_total"] == 7
+
+
 # ---------------------------------------------------------------------------
 # observed-run extraction (ISSUE 16: the obs -> autotune bridge)
 # ---------------------------------------------------------------------------

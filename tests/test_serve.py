@@ -27,12 +27,43 @@ from gke_ray_train_tpu.serve import (
 EOS = 5
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture(scope="session")
 def setup():
     cfg = tiny(vocab_size=97, d_model=32, n_layers=2, n_heads=4,
                n_kv_heads=2, d_ff=64, dtype="float32",
                param_dtype="float32")
     return cfg, init_params(cfg, jax.random.key(0))
+
+
+@pytest.fixture(scope="session")
+def shared_engine(setup):
+    """ONE default-plan engine for the tests that only need *an*
+    engine (admission checks, truncation, ...): every BatchEngine
+    construction costs three executables per bucket, and the suite's
+    tier-1 wall is the budget this fixture spends once."""
+    cfg, params = setup
+    return BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
+
+
+@pytest.fixture(scope="session")
+def tenant_trees(setup):
+    """(LoraConfig, three deterministic NON-identity adapter trees) —
+    init_lora starts at identity (b = 0), which would make every
+    multi-tenant bitwise check vacuously true; these tenants disagree
+    with the base model and with each other."""
+    from gke_ray_train_tpu.train.lora import LoraConfig, init_lora
+    cfg, _ = setup
+    lcfg = LoraConfig(r=2, alpha=4)
+
+    def mk(seed):
+        t = init_lora(cfg, lcfg, jax.random.key(seed))
+        leaves, td = jax.tree.flatten(t)
+        ks = jax.random.split(jax.random.key(seed + 1), len(leaves))
+        return jax.tree.unflatten(td, [
+            0.05 * jax.random.normal(k, l.shape, l.dtype)
+            for k, l in zip(ks, leaves)])
+
+    return lcfg, {f"t{i}": mk(20 + 2 * i) for i in (1, 2, 3)}
 
 
 def _plan(**kw):
@@ -155,9 +186,8 @@ def test_two_buckets_route_and_match(setup):
 # admission contract
 # ---------------------------------------------------------------------------
 
-def test_unservable_request_rejected_up_front(setup):
-    cfg, params = setup
-    eng = BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
+def test_unservable_request_rejected_up_front(shared_engine):
+    eng = shared_engine
     with pytest.raises(ValueError, match="largest usable bucket"):
         eng.submit(Request("big", np.arange(1, 10, dtype=np.int32),
                            max_new_tokens=200))
@@ -165,14 +195,18 @@ def test_unservable_request_rejected_up_front(setup):
         eng.submit(Request("empty", np.zeros((0,), np.int32), 8))
     with pytest.raises(ValueError, match="max_new_tokens"):
         eng.submit(Request("none", np.arange(1, 5, dtype=np.int32), 0))
+    with pytest.raises(ValueError, match="adapter"):
+        eng.submit(Request("tenant", np.arange(1, 5, dtype=np.int32), 8,
+                           adapter_id="t1"))   # no pool on this engine
 
 
-def test_overlong_prompt_truncates_loudly(setup, caplog):
+def test_overlong_prompt_truncates_loudly(setup, shared_engine, caplog):
     """The reference silently kept the LAST max_prompt tokens; the
     shared bucketing keeps the behavior but logs the drop."""
     cfg, params = setup
-    eng = BatchEngine(params, cfg, plan=_plan(), eos_ids=(EOS,))
-    req = _requests(cfg, [(140, 16)], seed=6)[0]
+    eng = shared_engine
+    req = dataclasses.replace(_requests(cfg, [(140, 16)], seed=6)[0],
+                              rid="trunc0")
     with caplog.at_level("WARNING"):
         assert eng.submit(req) == 128
     assert any("DROPPED" in r.message for r in caplog.records)
@@ -282,6 +316,9 @@ def test_plan_change_invalidates_serve_sidecar(setup, tmp_path):
 # quantized serving
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # a full int8 engine build + oracle decode (~10s);
+# the fast quantization contract stays in tier-1 via
+# test_quantize_for_serving_contract below
 def test_quantized_weights_serving_matches_quantized_oracle(setup):
     """serve_quant=int8 quantizes at engine construction; outputs are
     bitwise-identical to the sequential oracle run on the SAME
@@ -380,18 +417,24 @@ def test_serve_decode_budget_checked_in():
     re-baselines — review the JSON diff like code."""
     from gke_ray_train_tpu.perf.budget import (
         SERVE_PRESETS, assert_within_budget, budget_path,
-        build_preset_report, plan_for_preset, write_budget)
+        build_budget_doc, plan_for_preset, write_budget)
     for name in SERVE_PRESETS:
-        rep = build_preset_report(name)
+        doc = build_budget_doc(name)
         path = budget_path(name)
         if os.environ.get("BUDGET_UPDATE") == "1":
-            write_budget(rep, path, preset=name)
+            write_budget(doc, path, preset=name)
             continue
         assert os.path.exists(path), (
             f"missing budget {path}; record it: python -m "
             "gke_ray_train_tpu.perf.budget record")
-        assert_within_budget(rep, path, plan=plan_for_preset(name))
-        assert sum(rep.collective_counts.values()) == 0
+        assert_within_budget(doc, path, plan=plan_for_preset(name))
+        assert sum(doc["collective_counts"].values()) == 0
+        # the modeled per-tenant fields ride (and are therefore pinned
+        # in) every serve budget — serve_multilora8's is the recorded
+        # multi-tenant throughput/latency claim
+        for f in ("serve_tenant_p50_s", "serve_tenant_p99_s",
+                  "serve_tokens_per_s_per_chip"):
+            assert doc[f] > 0
 
 
 def test_serve_preset_plan_is_pinned_consistently():
@@ -399,12 +442,329 @@ def test_serve_preset_plan_is_pinned_consistently():
     plancheck's PLAN004 sweep (a stale serve budget fails lint)."""
     from gke_ray_train_tpu.analysis.plancheck import repo_budget_findings
     from gke_ray_train_tpu.perf.budget import (
-        budget_path, load_budget, plan_for_preset)
-    doc = load_budget(budget_path("serve_tiny8"))
-    assert doc["_plan_fingerprint"] == \
-        plan_for_preset("serve_tiny8").fingerprint()
-    assert not [f for f in repo_budget_findings()
-                if f.field == "serve_tiny8"]
+        SERVE_PRESETS, budget_path, load_budget, plan_for_preset)
+    for name in SERVE_PRESETS:
+        doc = load_budget(budget_path(name))
+        assert doc["_plan_fingerprint"] == \
+            plan_for_preset(name).fingerprint()
+        assert not [f for f in repo_budget_findings()
+                    if f.field == name]
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant serving (ISSUE 17): batched multi-LoRA, adapter cache,
+# prefix reuse, speculative decoding
+# ---------------------------------------------------------------------------
+
+def _lora_oracle(params, cfg, req, bucket, lora, lora_scale):
+    """Batch-1 greedy with ONE adapter — the sequential per-adapter
+    reference a mixed-tenant batch must reproduce bitwise."""
+    buf, plen = form_prompt_buffer(req.token_ids, bucket)
+    out = greedy_generate_cached(
+        params, jnp.asarray(buf), jnp.asarray([plen], jnp.int32), cfg,
+        max_new_tokens=req.max_new_tokens, eos_ids=(EOS,),
+        lora=lora, lora_scale=lora_scale if lora is not None else 1.0)
+    return np.asarray(out[0])
+
+
+def test_mixed_adapter_batch_matches_per_adapter_oracle(setup,
+                                                       tenant_trees):
+    """The tentpole bitwise drill: one mixed-tenant batch (two LoRA
+    tenants + the base model, more requests than slots so refills
+    SWITCH the adapter occupying a slot mid-decode) equals the
+    sequential per-adapter oracle bit for bit — and the whole run,
+    tenant churn included, never leaves the one warmed decode
+    executable (RecompileDetector-asserted)."""
+    from gke_ray_train_tpu.analysis.jaxprcheck import RecompileDetector
+    from gke_ray_train_tpu.serve.adapters import AdapterPool
+    cfg, params = setup
+    lcfg, trees = tenant_trees
+    pool = AdapterPool.from_template(trees["t1"], max_adapters=4)
+    for aid in ("t1", "t2"):
+        pool.register(aid, trees[aid])
+    eng = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                      eos_ids=(EOS,), adapters=pool,
+                      lora_scale=lcfg.scale)
+    eng.warm_up()
+    assert len(eng.executable_info()) == 3   # the engine contract holds
+    spec = [(7, 10, "t1"), (25, 12, "t2"), (12, 8, None),
+            (9, 10, "t1"), (30, 14, "t2")]
+    reqs = [dataclasses.replace(r, adapter_id=a)
+            for r, (_, _, a) in zip(
+                _requests(cfg, [(p, m) for p, m, _ in spec], seed=31),
+                spec)]
+    with RecompileDetector() as det:
+        comps = eng.run_until_drained(reqs)
+    assert not det.findings(), det.findings()
+    assert eng.refills >= 2        # slots changed tenants mid-batch
+    for r, c in zip(reqs, comps):
+        assert c.adapter_id == r.adapter_id
+        np.testing.assert_array_equal(
+            c.tokens, _lora_oracle(params, cfg, r, 128,
+                                   trees.get(r.adapter_id), lcfg.scale))
+    stats = eng.stats()
+    assert stats["adapter_hits"] == 4 and stats["adapter_misses"] == 0
+    assert stats["adapter_evictions"] == 0
+
+
+def test_zero_adapter_slot_is_bitwise_base_model(setup, tenant_trees):
+    """A request WITHOUT an adapter_id on a pooled engine routes to the
+    reserved zero slot and must equal the plain no-LoRA oracle exactly
+    — adding an exact-zero delta cannot move an argmax."""
+    from gke_ray_train_tpu.serve.adapters import AdapterPool
+    cfg, params = setup
+    lcfg, trees = tenant_trees
+    pool = AdapterPool.from_template(trees["t1"], max_adapters=2)
+    pool.register("t1", trees["t1"])
+    eng = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                      eos_ids=(EOS,), adapters=pool,
+                      lora_scale=lcfg.scale)
+    req = _requests(cfg, [(14, 10)], seed=33)[0]
+    comps = eng.run_until_drained([req])
+    np.testing.assert_array_equal(comps[0].tokens,
+                                  _oracle(params, cfg, req, 128))
+
+
+def test_adapter_pool_lru_eviction_and_pinning(setup, tenant_trees):
+    """The adapter cache in isolation: loader-backed misses, LRU
+    eviction under capacity pressure, pinned slots never evicted, the
+    reserved zero slot untouchable, counters exact."""
+    from gke_ray_train_tpu.serve.adapters import (
+        AdapterPool, AdapterPoolPinned)
+    cfg, _ = setup
+    _, trees = tenant_trees
+    pool = AdapterPool.from_template(trees["t1"], max_adapters=2,
+                                     loader=lambda aid: trees[aid])
+    assert pool.acquire(None) == 0          # zero slot, never pinned
+    s1 = pool.acquire("t1")                 # miss -> loader -> resident
+    pool.acquire("t2")                      # miss; pool now full
+    pool.release("t1")                      # t1 unpinned, t2 pinned
+    s3 = pool.acquire("t3")                 # evicts LRU-unpinned t1
+    assert s3 == s1 and "t1" not in pool and "t2" in pool
+    st = pool.stats()
+    assert st["adapter_misses"] == 3 and st["adapter_evictions"] == 1
+    assert st["adapter_resident"] == 2
+    pool.acquire("t2")                      # hit
+    assert pool.stats()["adapter_hits"] == 1
+    with pytest.raises(AdapterPoolPinned):  # t2, t3 both pinned
+        pool.acquire("t1")
+    with pytest.raises(ValueError, match="immutable"):
+        pool.register("t2", trees["t2"])    # ids are immutable
+
+
+def test_engine_retries_admission_when_pool_pinned(setup, tenant_trees):
+    """Eviction under pressure THROUGH the engine: with one tenant slot
+    and every slot pinned by an in-flight request, a second tenant's
+    request stays pending (no crash) and is admitted — evicting the
+    retired tenant — once the slot frees."""
+    from gke_ray_train_tpu.serve.adapters import AdapterPool
+    cfg, params = setup
+    lcfg, trees = tenant_trees
+    pool = AdapterPool.from_template(trees["t1"], max_adapters=1,
+                                     loader=lambda aid: trees[aid])
+    eng = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                      eos_ids=(EOS,), adapters=pool,
+                      lora_scale=lcfg.scale)
+    r1, r2 = [dataclasses.replace(r, adapter_id=a)
+              for r, a in zip(_requests(cfg, [(10, 12), (8, 6)],
+                                        seed=35), ("t1", "t2"))]
+    eng.submit(r1)
+    eng.submit(r2)
+    eng.step()                     # r1 admitted+decoding; r2 pinned out
+    assert eng.completion(r2.rid) is None
+    assert eng.stats()["pending"] == 1
+    by_rid = {c.rid: c for c in eng.run_until_drained()}
+    assert set(by_rid) == {r1.rid, r2.rid}
+    for r in (r1, r2):
+        np.testing.assert_array_equal(
+            by_rid[r.rid].tokens,
+            _lora_oracle(params, cfg, r, 128, trees[r.adapter_id],
+                         lcfg.scale))
+    st = eng.stats()
+    assert st["adapter_evictions"] == 1 and st["adapter_misses"] == 2
+
+
+def test_prefix_reuse_bitwise_and_counted(setup):
+    """Identical prompts prefill ONCE: the reused KV row + first token
+    are bitwise what a cold prefill produces (same executable, same
+    inputs), so completions match a no-reuse engine exactly; the hit
+    counter is exact; the stats key exists only when the feature is
+    on."""
+    cfg, params = setup
+    shared = _requests(cfg, [(18, 10)], seed=37)[0]
+    reqs = [dataclasses.replace(shared, rid=f"p{i}") for i in range(3)]
+    reqs.append(dataclasses.replace(
+        _requests(cfg, [(9, 10)], seed=38)[0], rid="other"))
+    cold = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                       eos_ids=(EOS,))
+    warm = BatchEngine(params, cfg,
+                       plan=_plan(max_batch=2, prefix_cache=True),
+                       eos_ids=(EOS,))
+    comps_c = cold.run_until_drained(
+        [dataclasses.replace(r) for r in reqs])
+    comps_w = warm.run_until_drained(reqs)
+    for a, b in zip(comps_c, comps_w):
+        assert a.rid == b.rid
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+    assert warm.stats()["prefix_hits"] == 2   # 3 identical: 1 cold + 2
+    assert "prefix_hits" not in cold.stats()
+
+
+def test_speculative_self_draft_accept_all_bitwise(setup):
+    """SPEC_DRAFT=self: the draft IS the target, so every in-window
+    proposal verifies (the accept-all arm) — outputs must be bitwise
+    the plain engine's, in ~1/(K+1) the decode iterations, with the
+    acceptance ledger counting every accepted token."""
+    cfg, params = setup
+    reqs = _requests(cfg, [(7, 12), (20, 10), (12, 14)], seed=39)
+    plain = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                        eos_ids=(EOS,))
+    comps_p = plain.run_until_drained(
+        [dataclasses.replace(r) for r in reqs])
+    spec = BatchEngine(params, cfg,
+                       plan=_plan(max_batch=2, spec_draft="self",
+                                  spec_k=3),
+                       eos_ids=(EOS,))
+    spec.warm_up()
+    assert len(spec.executable_info()) == 3  # still ONE fused decode
+    comps_s = spec.run_until_drained(reqs)
+    for a, b in zip(comps_p, comps_s):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+    sp, ss = plain.stats(), spec.stats()
+    assert ss["iterations"] < sp["iterations"]
+    assert 0 < ss["spec_accepted"] <= ss["spec_proposed"]
+    assert "spec_proposed" not in sp
+
+
+def test_speculative_garbage_draft_still_bitwise(setup):
+    """The forced-reject arm: a DISTILLED draft with random weights
+    proposes mostly-wrong tokens — the verify step must reject them and
+    the output stays bitwise the plain engine's (speculation may only
+    ever change HOW FAST tokens appear, never WHICH tokens)."""
+    cfg, params = setup
+    draft_params = init_params(cfg, jax.random.key(99))
+    reqs = _requests(cfg, [(9, 10), (16, 8)], seed=41)
+    plain = BatchEngine(params, cfg, plan=_plan(max_batch=2),
+                        eos_ids=(EOS,))
+    comps_p = plain.run_until_drained(
+        [dataclasses.replace(r) for r in reqs])
+    spec = BatchEngine(params, cfg,
+                       plan=_plan(max_batch=2, spec_draft="distilled",
+                                  spec_k=3),
+                       eos_ids=(EOS,), draft=(draft_params, cfg))
+    comps_s = spec.run_until_drained(reqs)
+    for a, b in zip(comps_p, comps_s):
+        np.testing.assert_array_equal(a.tokens, b.tokens)
+    ss = spec.stats()
+    # a random draft agrees with the target only by accident
+    assert ss["spec_accepted"] < ss["spec_proposed"]
+
+
+def test_speculation_composes_with_adapters_bitwise(setup,
+                                                   tenant_trees):
+    """Speculation + multi-LoRA together: the draft proposes adapter-
+    free, the pooled target verifies per-tenant — outputs must still be
+    bitwise the (non-speculative) per-adapter oracle's."""
+    from gke_ray_train_tpu.serve.adapters import AdapterPool
+    cfg, params = setup
+    lcfg, trees = tenant_trees
+    pool = AdapterPool.from_template(trees["t1"], max_adapters=2)
+    pool.register("t1", trees["t1"])
+    eng = BatchEngine(params, cfg,
+                      plan=_plan(max_batch=2, spec_draft="self",
+                                 spec_k=2),
+                      eos_ids=(EOS,), adapters=pool,
+                      lora_scale=lcfg.scale)
+    spec = [("t1", (11, 10)), (None, (19, 8))]
+    reqs = [dataclasses.replace(r, adapter_id=a)
+            for r, (a, _) in zip(
+                _requests(cfg, [s for _, s in spec], seed=43), spec)]
+    comps = eng.run_until_drained(reqs)
+    for r, c in zip(reqs, comps):
+        np.testing.assert_array_equal(
+            c.tokens, _lora_oracle(params, cfg, r, 128,
+                                   trees.get(r.adapter_id), lcfg.scale))
+
+
+def test_speculative_headroom_enters_admission(setup, shared_engine,
+                                               caplog):
+    """Routing budgets prompt + max_new + SPEC_K: the verify window
+    must never clamp into an active row's committed history, so a
+    prompt that fits a plain engine's bucket EXACTLY is over budget on
+    the speculative engine and truncated loudly, with the tightened
+    budget named."""
+    cfg, params = setup
+    # 108 + 20 == 128: fits plain exactly; + spec_k it does not
+    req = Request("tight", np.arange(1, 109, dtype=np.int32), 20)
+    with caplog.at_level("WARNING"):
+        shared_engine.submit(
+            dataclasses.replace(req, rid="tight-plain"))
+    assert not any("DROPPED" in r.message for r in caplog.records)
+    while shared_engine.step() > 0:   # don't leak a pending request
+        pass                          # into later shared-engine tests
+    caplog.clear()
+    spec = BatchEngine(params, cfg,
+                       plan=_plan(max_batch=2, spec_draft="self",
+                                  spec_k=4),
+                       eos_ids=(EOS,))
+    with caplog.at_level("WARNING"):
+        spec.submit(req)              # routing only — no compile
+    assert any("104-token budget" in r.message
+               for r in caplog.records)
+
+
+def test_multitenant_plan_knobs_three_dialects_and_surfaces():
+    """MAX_ADAPTERS / PREFIX_CACHE / SPEC_DRAFT / SPEC_K land
+    identically from kwargs and config dialects, validate loudly, and
+    split ONLY the serve compile surface (a serving retune must not
+    stale the training sidecar)."""
+    cfg_plan = ExecutionPlan.from_config(
+        {"MAX_ADAPTERS": "4", "PREFIX_CACHE": "1",
+         "SPEC_DRAFT": "SELF", "SPEC_K": "3"})
+    kw_plan = ExecutionPlan.from_kwargs(
+        max_adapters=4, prefix_cache=True, spec_draft="self", spec_k=3)
+    assert cfg_plan.fingerprint() == kw_plan.fingerprint()
+    assert ExecutionPlan.from_config(
+        {"SPEC_DRAFT": "off"}).spec_draft == "none"
+    with pytest.raises(Exception, match="spec_draft"):
+        ExecutionPlan.from_kwargs(spec_draft="oracle")
+    with pytest.raises(Exception, match="max_adapters"):
+        ExecutionPlan.from_kwargs(max_adapters=0)
+    with pytest.raises(Exception, match="spec_k"):
+        ExecutionPlan.from_kwargs(spec_draft="self", spec_k=0)
+    base = ExecutionPlan.from_kwargs()
+    for kw in (dict(max_adapters=4), dict(prefix_cache=True),
+               dict(spec_draft="self"), dict(spec_k=8)):
+        p = ExecutionPlan.from_kwargs(**kw)
+        assert p.compile_fingerprint("serve") \
+            != base.compile_fingerprint("serve"), kw
+        assert p.compile_fingerprint("train") \
+            == base.compile_fingerprint("train"), kw
+
+
+def test_post_train_smoke_serves_tagged_adapters(setup, tenant_trees):
+    """Satellite: the SERVE_AFTER_TRAIN smoke with adapter_id tags
+    routes tagged prompts through a real AdapterPool (the batched
+    multi-tenant path end to end) and reports the tenant traffic."""
+    cfg, params = setup
+    lcfg, trees = tenant_trees
+    out = post_train_smoke(
+        params, cfg, _plan(max_batch=2),
+        [np.arange(1, 20, dtype=np.int32),
+         np.arange(1, 9, dtype=np.int32)],
+        eos_ids=(EOS,), max_new_tokens=6,
+        lora=trees["t1"], lora_scale=lcfg.scale,
+        adapter_ids=["tuned", None])
+    assert out is not None
+    comps, stats = out
+    assert [c.adapter_id for c in comps] == ["tuned", None]
+    assert stats["adapter_requests"] == 1
+    assert stats["generated_tokens"] > 0
+    # the tagged completion really decoded THROUGH the adapter
+    req = Request("o", np.arange(1, 20, dtype=np.int32), 6)
+    np.testing.assert_array_equal(
+        comps[0].tokens,
+        _lora_oracle(params, cfg, req, 128, trees["t1"], lcfg.scale))
 
 
 # ---------------------------------------------------------------------------
