@@ -1,0 +1,267 @@
+"""Peer-slice hot-state replication (ISSUE 18 tentpole b).
+
+A slice eviction today costs a full storage round-trip: the rescheduled
+attempt restores from the last committed checkpoint and replays every
+step since. But on a multi-slice job the OTHER slice is usually still
+alive and holds a byte-identical replica of the optimizer+param state
+(the data axis — the only axis that spans slices, per the PR-5
+contract — replicates state across slices). This module keeps that
+replica REACHABLE: at every snapshot each slice streams its state, as
+the per-device scattered shards the cross-slice hop of
+``parallel/hierarchical.py`` already moves, to its ring neighbor
+``(slice + 1) % num_slices``; after a ``slice_evict`` the survivor
+serves the resume directly — no storage read, no replay past the last
+snapshot.
+
+Emulation shape (the CPU-mesh stand-in for the DCN stream): the hot
+store is a process-global dict keyed by the checkpoint directory —
+every slice of the emulated mesh lives in this process, so "streaming
+to the peer" is a handoff into the peer's keyed slot, and
+``evict_slice`` deletes a slot exactly as the eviction kills that
+slice's host memory. The BYTES are accounted for real, though: one
+round moves ``num_slices x replica_nbytes`` across DCN
+(``parallel.hierarchical.peer_replication_elems`` is the static
+element oracle; :func:`round_dcn_bytes` the byte one), and
+``perf/budget.py`` pins the live counter against it at tolerance 0.
+
+``compress="bf16"`` (``PEER_COMPRESS=bf16``) casts the floating leaves
+of the stream to bf16 with error feedback ACROSS ROUNDS — round *k*'s
+quantization residual is added back into round *k+1*'s pre-cast value,
+the same machinery as ``DCN_COMPRESS`` — halving the replication
+bytes. Not bitwise; the restore-bitwise drills run uncompressed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# run_key (checkpoint dir) -> holder slice -> replica record. Process-
+# global on purpose: the emulated slices share this process, and the
+# store must survive the per-attempt teardown of CheckpointManager
+# instances the way a peer slice's memory survives its neighbor's death.
+_HOT: Dict[str, Dict[int, dict]] = {}
+# run_key -> evicted holder indices. An evicted slice's memory is GONE:
+# later snapshots of the same run incarnation must not resurrect its
+# slot (the post-eviction grace save would otherwise 'stream to' a
+# slice that no longer exists). Cleared with reset() — a whole-job
+# retry is a new incarnation where every scheduled slice is back.
+_DEAD: Dict[str, set] = {}
+_LOCK = threading.Lock()
+
+
+def state_replica_nbytes(tree: Any) -> int:
+    """Bytes of ONE state replica (works on concrete arrays and
+    ShapeDtypeStructs — the budget side feeds it eval_shape leaves)."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", ())
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        n = 1
+        for s in shape:
+            n *= int(s)
+        total += n * dtype.itemsize
+    return total
+
+
+def round_dcn_bytes(tree: Any, num_slices: int) -> int:
+    """DCN bytes one uncompressed replication round moves: every slice
+    streams its full replica to its ring neighbor."""
+    return max(int(num_slices), 1) * state_replica_nbytes(tree)
+
+
+def reset(run_key: Optional[str] = None) -> None:
+    """Drop hot state (one run's, or everything) — test isolation."""
+    with _LOCK:
+        if run_key is None:
+            _HOT.clear()
+            _DEAD.clear()
+        else:
+            _HOT.pop(str(run_key), None)
+            _DEAD.pop(str(run_key), None)
+
+
+class PeerReplicator:
+    """The replication endpoint one CheckpointManager binds
+    (``PEER_REPLICATION=1``): ``replicate`` on every snapshot,
+    ``peek``/``restore`` on resume, ``evict_slice`` from the fault
+    drill."""
+
+    def __init__(self, num_slices: Optional[int] = None, *,
+                 compress: str = "none",
+                 shards_per_stream: Optional[int] = None):
+        if num_slices is None:
+            num_slices = int(os.environ.get("NUM_SLICES", "2") or "2")
+        self.num_slices = max(int(num_slices), 1)
+        if compress not in ("none", "bf16"):
+            raise ValueError(f"unknown peer compression {compress!r} "
+                             "(none|bf16)")
+        self.compress = compress
+        if shards_per_stream is None:
+            # the per-device shard granularity of the emulated stream:
+            # the slice's ICI width (devices per slice) when derivable
+            try:
+                shards_per_stream = max(
+                    jax.device_count() // self.num_slices, 1)
+            except Exception:  # noqa: BLE001 - backend-free callers
+                shards_per_stream = 1
+        self.shards_per_stream = max(int(shards_per_stream), 1)
+        # error-feedback residuals for bf16 streams, per run_key + leaf
+        self._residual: Dict[str, List[Optional[np.ndarray]]] = {}
+        self.last_round_bytes = 0
+        self.total_bytes = 0
+        self.rounds = 0
+
+    @classmethod
+    def from_env(cls) -> "PeerReplicator":
+        return cls(compress=os.environ.get("PEER_COMPRESS", "none")
+                   or "none")
+
+    # ------------------------------------------------------------------
+
+    def _split(self, arr: np.ndarray) -> List[np.ndarray]:
+        """The scattered-shard framing of one leaf's stream (cosmetic
+        for byte accounting — concatenate inverts it exactly)."""
+        if arr.ndim == 0 or arr.shape[0] < 2:
+            return [arr]
+        pieces = min(self.shards_per_stream, arr.shape[0])
+        return list(np.array_split(arr, pieces, axis=0))
+
+    def _encode(self, run_key: str, leaves: List[np.ndarray]
+                ) -> Tuple[List[np.ndarray], int]:
+        """(streamed leaves, streamed bytes) — bf16 cast with
+        cross-round error feedback when compression is on."""
+        if self.compress == "none":
+            return leaves, sum(x.nbytes for x in leaves)
+        import jax.numpy as jnp
+        res = self._residual.setdefault(run_key,
+                                        [None] * len(leaves))
+        if len(res) != len(leaves):  # tree changed shape: start over
+            res = self._residual[run_key] = [None] * len(leaves)
+        out: List[np.ndarray] = []
+        nbytes = 0
+        for i, x in enumerate(leaves):
+            if not np.issubdtype(x.dtype, np.floating):
+                out.append(x)
+                nbytes += x.nbytes
+                continue
+            y = x if res[i] is None else x + res[i]
+            q = np.asarray(jnp.asarray(y, jnp.bfloat16))
+            res[i] = np.asarray(y - np.asarray(q, y.dtype), x.dtype)
+            out.append(q)
+            nbytes += q.nbytes
+        return out, nbytes
+
+    def replicate(self, run_key: str, step: int,
+                  host_state: Any) -> dict:
+        """Stream this snapshot to the ring neighbor of every slice.
+        One emulated round: ``num_slices`` identical replicas move (the
+        data axis replicates state across slices), so holder ``h`` ends
+        up with the state owned by slice ``(h - 1) % num_slices``.
+        Returns ``{"bytes", "to_slice", "step"}`` — ``bytes`` is the
+        ROUND total (what crosses DCN), ``to_slice`` the ring offset."""
+        run_key = str(run_key)
+        leaves, treedef = jax.tree.flatten(host_state)
+        leaves = [np.asarray(x) for x in leaves]
+        streamed, per_stream = self._encode(run_key, leaves)
+        chunks = [self._split(x) for x in streamed]
+        with _LOCK:
+            slot = _HOT.setdefault(run_key, {})
+            dead = _DEAD.get(run_key, set())
+            alive = [h for h in range(self.num_slices) if h not in dead]
+            total = per_stream * len(alive)
+            for holder in alive:
+                owner = (holder - 1) % self.num_slices
+                slot[holder] = {
+                    "step": int(step),
+                    "from_slice": holder,
+                    "owner": owner,
+                    "treedef": treedef,
+                    "chunks": chunks,
+                    "bytes": per_stream,
+                    "compress": self.compress,
+                }
+        self.last_round_bytes = total
+        self.total_bytes += total
+        self.rounds += 1
+        logger.info("peer-replicated step %d: %d B across DCN "
+                    "(%d streams x %d B, compress=%s)", step, total,
+                    len(alive), per_stream, self.compress)
+        return {"bytes": total, "to_slice": 1, "step": int(step)}
+
+    # ------------------------------------------------------------------
+
+    def peek(self, run_key: str) -> Optional[int]:
+        """Newest step any SURVIVING holder can serve (None: no hot
+        state — fall back to storage)."""
+        with _LOCK:
+            slot = _HOT.get(str(run_key))
+            if not slot:
+                return None
+            return max(rec["step"] for rec in slot.values())
+
+    def restore(self, run_key: str, template: Any
+                ) -> Tuple[Any, dict]:
+        """Rebuild the state from a surviving holder's hot replica and
+        place it onto the template's shardings. Uncompressed streams
+        restore BITWISE-identical to the storage path (both copy the
+        same host snapshot). Returns ``(state, {"step", "bytes",
+        "from_slice"})``."""
+        with _LOCK:
+            slot = _HOT.get(str(run_key))
+            if not slot:
+                raise LookupError(f"no peer hot state for {run_key}")
+            holder = max(slot, key=lambda h: (slot[h]["step"], h))
+            rec = slot[holder]
+        t_leaves, treedef = jax.tree.flatten(template)
+        if treedef != rec["treedef"]:
+            raise ValueError(
+                "peer hot state tree structure does not match the "
+                "restore template (plan changed since the snapshot)")
+        out_leaves = []
+        for pieces, like in zip(rec["chunks"], t_leaves):
+            arr = pieces[0] if len(pieces) == 1 \
+                else np.concatenate(pieces, axis=0)
+            dtype = getattr(like, "dtype", arr.dtype)
+            if arr.dtype != dtype:
+                arr = arr.astype(dtype)  # decompress (bf16 stream)
+            sharding = getattr(like, "sharding", None)
+            out_leaves.append(jax.device_put(arr, sharding)
+                              if sharding is not None
+                              else jax.device_put(arr))
+        state = jax.tree.unflatten(treedef, out_leaves)
+        meta = {"step": int(rec["step"]), "bytes": int(rec["bytes"]),
+                "from_slice": int(holder)}
+        logger.info("restored step %d from peer slice %d hot state "
+                    "(%d B, no storage read)", rec["step"], holder,
+                    rec["bytes"])
+        return state, meta
+
+    # ------------------------------------------------------------------
+
+    def evict_slice(self, run_key: str, slice_index: int) -> bool:
+        """The eviction kills that slice's memory: its hot slot dies
+        with it, and the slot stays dead for the rest of this run
+        incarnation (later snapshots — e.g. the post-eviction grace
+        save — must not 'stream to' a slice that no longer exists).
+        True when there was one to kill."""
+        with _LOCK:
+            _DEAD.setdefault(str(run_key), set()).add(int(slice_index))
+            slot = _HOT.get(str(run_key))
+            if slot is None:
+                return False
+            return slot.pop(int(slice_index), None) is not None
+
+    def holders(self, run_key: str) -> Dict[int, int]:
+        """Surviving holder -> step (diagnostics/tests)."""
+        with _LOCK:
+            slot = _HOT.get(str(run_key), {})
+            return {int(h): int(rec["step"])
+                    for h, rec in slot.items()}

@@ -114,6 +114,16 @@ KNOWN_KEYS = frozenset({
     # Trainer-scoped (like SERVE_AFTER_TRAIN), not plan-scoped: they
     # change retry policy, never the compiled program.
     "ELASTIC", "MIN_DEVICES",
+    # goodput knobs (ckpt/manager.py, ckpt/peer.py): ASYNC_CKPT=1 moves
+    # the storage commit behind a write-ahead marker on a background
+    # thread; PEER_REPLICATION=1 streams snapshots to the peer slice's
+    # hot store; CKPT_COMMIT_TIMEOUT_S bounds the exit-time commit
+    # drain. Trainer-scoped like ELASTIC: recovery policy only — the
+    # compiled program and the loss stream are bitwise unchanged.
+    # CKPT_STORAGE_DELAY_S emulates the storage round-trip per commit
+    # (the chaos drill's stand-in for GCS latency)
+    "ASYNC_CKPT", "PEER_REPLICATION", "CKPT_COMMIT_TIMEOUT_S",
+    "CKPT_STORAGE_DELAY_S",
     # autotune registry/search knobs (autotune/): AUTOTUNE_DIR points
     # the tuned-plan registry somewhere other than <repo>/tuned_plans;
     # AUTOTUNE_BUDGET caps the full-compile count the search spends

@@ -40,10 +40,12 @@ from typing import Any, Dict, List, Optional
 # map to no term (reshard time is inside restore; serve runs post-loop).
 SPAN_TERM = {
     "restore": "restore_s",
+    "peer_restore": "peer_restore_s",
     "compile": "compile_s",
     "fast_forward": "fast_forward_s",
     "eval": "eval_ckpt_stall_s",
     "ckpt_save": "eval_ckpt_stall_s",
+    "ckpt_snapshot": "ckpt_async_s",
     "preempt_save": "eval_ckpt_stall_s",
 }
 # the terms whose span measurement must agree with the ledger exactly
@@ -51,7 +53,8 @@ SPAN_TERM = {
 # step windows legitimately undercover the loop's residual (the ledger
 # books step_s as wall minus everything else).
 RECONCILED_TERMS = ("restore_s", "compile_s", "fast_forward_s",
-                    "eval_ckpt_stall_s", "data_stall_s")
+                    "eval_ckpt_stall_s", "data_stall_s",
+                    "ckpt_async_s", "peer_restore_s")
 RECONCILE_TOL = 1e-6
 MAX_PATH = 64
 

@@ -50,6 +50,20 @@ EVENT_KINDS: Dict[str, tuple] = {
              "tokens_per_sec_per_chip", "mfu", "data_stall_frac"),
     "eval": ("metrics",),
     "ckpt_save": ("save_s", "forced"),
+    # async write-ahead checkpointing (ckpt/manager.py, ISSUE 18):
+    # ckpt_snapshot is the loop-side device→host snapshot + committer
+    # enqueue (snapshot_s = the residual blocking time the ledger books
+    # as ckpt_async_s); ckpt_commit is the committer thread's
+    # serialize-to-storage lifecycle behind the COMMITTING/COMMITTED
+    # marker pair (status: ok | error)
+    "ckpt_snapshot": ("snapshot_s", "forced"),
+    "ckpt_commit": ("commit_s", "status"),
+    # peer-slice hot-state replication (ckpt/peer.py): each slice
+    # streams its shards to a peer over the DCN hop at snapshot time;
+    # a slice_evict retry restores from the living peer instead of
+    # storage (restore_s = the ledger's peer_restore_s float)
+    "peer_replicate": ("bytes", "to_slice", "replicate_s"),
+    "peer_restore": ("restore_s", "bytes", "from_slice"),
     "epoch_end": ("epoch",),
     "preempt_exit": ("save_s", "grace_remaining_s", "pool"),
     "worker_exit": ("status", "goodput"),

@@ -54,6 +54,8 @@ METRIC_NAMES: Dict[str, str] = {
     "goodput_fast_forward_s": "gauge",
     "goodput_data_stall_s": "gauge",
     "goodput_eval_ckpt_stall_s": "gauge",
+    "goodput_ckpt_async_s": "gauge",
+    "goodput_peer_restore_s": "gauge",
     "goodput_step_s": "gauge",
     "goodput_lost_s": "gauge",
     "goodput_wall_s": "gauge",

@@ -35,7 +35,8 @@ logger = logging.getLogger(__name__)
 # STRING list on purpose — the report must run without jax, and the
 # schema contract test pins the two against each other
 LEDGER_TERMS = ["compile_s", "restore_s", "fast_forward_s",
-                "data_stall_s", "eval_ckpt_stall_s", "step_s", "lost_s"]
+                "data_stall_s", "eval_ckpt_stall_s", "ckpt_async_s",
+                "peer_restore_s", "step_s", "lost_s"]
 RECONCILE_TOL = 1e-6
 
 

@@ -77,6 +77,15 @@ SPAN_NAMES: Dict[str, tuple] = {
     "step_window": ("steps", "data_stall_s"),
     "eval": (),
     "ckpt_save": ("forced",),
+    # async-commit save twin of ckpt_save (ISSUE 18): the loop's
+    # residual blocking window (snapshot + enqueue) — the exact float
+    # booked as ckpt_async_s; the storage commit runs in a background
+    # thread and is an EVENT (ckpt_commit), never a span, because it
+    # occupies no loop wall-clock to attribute
+    "ckpt_snapshot": ("forced",),
+    # restore served from a peer slice's hot state (ckpt/peer.py) —
+    # the exact float booked as peer_restore_s
+    "peer_restore": ("resumed_step",),
     "preempt_save": (),
     # elastic reshard (rayint/elastic.py plan re-formation + the
     # ckpt/manager.py resharded restore — the same twin pair the

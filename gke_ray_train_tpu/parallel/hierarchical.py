@@ -319,3 +319,20 @@ def leaf_payload_split(shapes: List[Tuple[int, ...]],
             denom = topo.fsdp * (topo.data_intra if scatter_intra else 1)
             hier += n // max(denom, 1)
     return flat, hier
+
+
+def peer_replication_elems(shapes: List[Tuple[int, ...]],
+                           num_slices: int) -> int:
+    """Elements one peer-replication round (ckpt/peer.py) sends across
+    DCN: every slice streams its full state replica to its ring
+    neighbor, so the round moves ``num_slices`` x the replica size —
+    the static oracle behind the ``peer_dcn_bytes`` budget pin
+    (tolerance 0: the live replicator's byte counter must match this
+    arithmetic exactly)."""
+    per_replica = 0
+    for shape in shapes:
+        n = 1
+        for s in shape:
+            n *= s
+        per_replica += n
+    return max(int(num_slices), 1) * per_replica

@@ -56,6 +56,12 @@ DEFAULT_TOLERANCES = {
     # gate (a pinned 0 stays exactly 0 on single-slice presets)
     "ici_bytes": 0.25,
     "dcn_bytes": 0.10,
+    # peer hot-state replication (ckpt/peer.py): bytes ONE snapshot's
+    # replication round streams across DCN on a hybrid preset. EXACT —
+    # the number is a pure function of the train-state tree (shapes x
+    # dtypes x num_slices, via jax.eval_shape), so any drift means the
+    # replicated tree itself changed and the pin must be re-reviewed
+    "peer_dcn_bytes": 0.0,
     # serve-preset modeled latency/throughput (serve_modeled_fields):
     # deterministic functions of the compile analyses + the declared
     # ChipSpec, so the same relative band as flops applies — a decode
@@ -365,7 +371,31 @@ def build_budget_doc(preset: Union[str, Preset, ServePreset],
     name = preset if isinstance(preset, str) else preset.name
     if isinstance(preset, ServePreset) or name in SERVE_PRESETS:
         doc.update(serve_modeled_fields(preset, report))
+    else:
+        p = PRESETS[name] if isinstance(preset, str) else preset
+        if p.num_slices > 1:
+            doc["peer_dcn_bytes"] = peer_replication_bytes(p)
     return doc
+
+
+def peer_replication_bytes(preset: Union[str, Preset]) -> int:
+    """DCN bytes ONE peer hot-state replication round moves on a hybrid
+    preset (``ckpt/peer.py``: every slice streams its full state replica
+    to its ring neighbor). Computed from the ABSTRACT train-state tree —
+    ``jax.eval_shape`` over the same model/optimizer the preset budgets,
+    no arrays materialized — so recording it costs no device memory and
+    the live replicator counter can be pinned against it exactly."""
+    import jax
+
+    from gke_ray_train_tpu.ckpt.peer import round_dcn_bytes
+    from gke_ray_train_tpu.train import make_optimizer, make_train_state
+
+    p = PRESETS[preset] if isinstance(preset, str) else preset
+    cfg = preset_model_cfg(p)
+    opt = make_optimizer(1e-3)
+    abstract = jax.eval_shape(
+        lambda key: make_train_state(cfg, opt, key), jax.random.key(0))
+    return round_dcn_bytes(abstract, p.num_slices)
 
 
 def preset_model_cfg(preset: Union[str, Preset, ServePreset]):

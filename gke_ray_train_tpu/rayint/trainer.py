@@ -694,7 +694,10 @@ class JaxTrainer:
                              for k in ("ELASTIC", "MIN_DEVICES",
                                        "NUM_SLICES", "KERNELCHECK",
                                        "AUTOTUNE_DIR",
-                                       "AUTOTUNE_DRIFT_BAND")
+                                       "AUTOTUNE_DRIFT_BAND",
+                                       "ASYNC_CKPT", "PEER_REPLICATION",
+                                       "CKPT_COMMIT_TIMEOUT_S",
+                                       "CKPT_STORAGE_DELAY_S")
                              if k in os.environ})
             env_base.update(self._pool_env())
             futures = [

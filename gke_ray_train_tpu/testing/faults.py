@@ -25,7 +25,11 @@ Fields:
   ``rayint/trainer.py``), ``slice_evict`` (one whole slice is evicted:
   like ``pool_shrink`` but the surviving count is derived from the
   slice layout — ``parallel/mesh.py::slice_assignments`` — and the
-  evicted slice is named).
+  evicted slice is named), ``kill_during_commit`` (the worker dies
+  while the async checkpoint committer is mid-commit: the in-flight
+  commit is frozen in its COMMITTING-without-COMMITTED state via
+  ``ckpt/manager.py::tear_mid_commit`` and the worker is killed — the
+  write-ahead recovery drill; requires an ``ASYNC_CKPT=1`` manager).
 - ``step`` (required int): global step AFTER which the fault fires
   (the loop calls ``on_step`` once per completed step).
 - ``rank`` (int or ``*``, default ``*``): which worker fires it.
@@ -60,7 +64,7 @@ from typing import List, Optional
 logger = logging.getLogger(__name__)
 
 KINDS = ("kill", "hang", "sigterm", "ckpt_truncate", "pool_shrink",
-         "slice_evict")
+         "slice_evict", "kill_during_commit")
 _FIELDS = ("rank", "kind", "step", "seconds", "to", "slice")
 
 
@@ -181,12 +185,22 @@ def current_pool(ckpt_dir: Optional[str] = None) -> Optional[int]:
     if _POOL is not None:
         return _POOL
     if ckpt_dir:
+        path = os.path.join(str(ckpt_dir), POOL_MARKER_NAME)
         try:
-            with open(os.path.join(str(ckpt_dir),
-                                   POOL_MARKER_NAME)) as f:
+            with open(path) as f:
                 return int(f.read().strip())
-        except (OSError, ValueError):
-            pass
+        except FileNotFoundError:
+            pass  # no marker: the pool was never shrunk
+        except (OSError, ValueError) as e:
+            # present but unreadable is NOT "full pool": a torn or
+            # permission-broken marker means the real pool size is
+            # indeterminate, and silently returning None here would
+            # make the trainer re-form the mesh on devices that may
+            # not exist — fail loudly instead
+            raise RuntimeError(
+                f"elastic pool marker {path} exists but is unreadable "
+                f"({type(e).__name__}: {e}); refusing to assume the "
+                "full pool — repair or remove the marker") from e
     return None
 
 
@@ -242,9 +256,30 @@ class FaultInjector:
             return False
         try:
             with open(path) as f:
-                return self._marker_key(spec) in f.read().splitlines()
-        except OSError:  # no marker yet
+                text = f.read()
+        except FileNotFoundError:  # no marker yet
             return False
+        except OSError:
+            # present but unreadable: the at-most-once guarantee is
+            # the one that must hold (a fault double-fired on resume
+            # breaks every recovery drill), so err on "already fired"
+            logger.warning("fired-fault marker %s is unreadable; "
+                           "treating every fault as already fired",
+                           path)
+            return True
+        key = self._marker_key(spec)
+        lines = text.splitlines()
+        if key in lines:
+            return True
+        # torn tail: the attempt that fired this fault was KILLED
+        # mid-append (the usual sequel to firing a kill fault), leaving
+        # a final line that is a strict prefix of the key. That fault
+        # DID fire — re-firing it would loop the drill forever
+        if text and not text.endswith("\n") and lines:
+            tail = lines[-1]
+            if tail and key.startswith(tail):
+                return True
+        return False
 
     def _mark_fired(self, spec: FaultSpec) -> None:
         _FIRED.add((self.rank, spec))
@@ -271,8 +306,11 @@ class FaultInjector:
         logger.warning("FAULT_SPEC firing kind=%s at step %d (rank %d)",
                        spec.kind, step, self.rank)
         if spec.kind == "kill":
+            self._evict_all_hot()
             raise InjectedKill(
                 f"injected kill at step {step} (rank {self.rank})")
+        if spec.kind == "kill_during_commit":
+            self._kill_during_commit(step)
         if spec.kind == "hang":
             time.sleep(spec.seconds)
         elif spec.kind == "sigterm":
@@ -284,6 +322,13 @@ class FaultInjector:
             self._pool_change(spec.to, step, reason="pool_shrink")
         elif spec.kind == "slice_evict":
             survivors, evicted = self._slice_evict_target(spec)
+            # the eviction kills that slice's host memory: its peer
+            # hot-state slot dies with it (the survivor's slot — holding
+            # the evicted slice's replica — is what the resume reads)
+            peer = getattr(self.ckpt_manager, "peer", None)
+            if peer is not None and self.ckpt_manager is not None:
+                peer.evict_slice(str(self.ckpt_manager.directory),
+                                 evicted)
             self._pool_change(survivors, step,
                               reason=f"slice_evict:slice={evicted}")
 
@@ -324,6 +369,41 @@ class FaultInjector:
                 "FAULT_SPEC slice_evict would evict the ENTIRE pool — "
                 "use kind=sigterm for a whole-job eviction")
         return survivors, evicted
+
+    def _evict_all_hot(self) -> None:
+        """A kill models the WHOLE emulated job dying: every slice's
+        memory — and with it every peer hot-state slot — is gone, and
+        only storage survives into the retry. (``slice_evict`` is the
+        one fault that leaves a living holder.) Without this, the
+        in-process retry would 'restore from peer' memory that no
+        longer exists on a real cluster."""
+        mgr = self.ckpt_manager
+        if mgr is not None and getattr(mgr, "peer", None) is not None:
+            from gke_ray_train_tpu.ckpt import peer as peer_hot
+            peer_hot.reset(str(mgr.directory))
+
+    def _kill_during_commit(self, step: int) -> None:
+        """The async-checkpointing recovery drill: freeze the in-flight
+        commit in its mid-commit on-disk state (COMMITTING without
+        COMMITTED — ``ckpt/manager.py::tear_mid_commit``), then die.
+        The resumed attempt must treat the torn step as never saved."""
+        mgr = self.ckpt_manager
+        if mgr is None:
+            raise RuntimeError(
+                "FAULT_SPEC kind=kill_during_commit needs a checkpoint "
+                "manager bound to the injector (run with checkpointing "
+                "enabled)")
+        if not getattr(mgr, "async_commit", False):
+            raise RuntimeError(
+                "FAULT_SPEC kind=kill_during_commit requires an "
+                "async-commit checkpoint manager (ASYNC_CKPT=1) — the "
+                "sync save path has no background commit window to "
+                "kill inside")
+        torn = mgr.tear_mid_commit()
+        self._evict_all_hot()
+        raise InjectedKill(
+            f"injected kill during commit of step {torn} "
+            f"(fired at step {step}, rank {self.rank})")
 
     def _truncate_latest(self, step: int) -> None:
         """Tear the newest checkpoint step the way an interrupted async

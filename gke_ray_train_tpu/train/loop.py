@@ -202,7 +202,13 @@ def run_training(state: TrainState,
             if resumed is not None:
                 state = full
         restore_dt = time.perf_counter() - t_restore0
-        ledger.note("restore_s", restore_dt)
+        # a resume served from the peer slice's hot state (ckpt/peer.py)
+        # books peer_restore_s, not restore_s — the ledger says which
+        # recovery path paid for the attempt's start
+        peer_served = getattr(ckpt_manager, "last_restore_source",
+                              None) == "peer"
+        ledger.note("peer_restore_s" if peer_served else "restore_s",
+                    restore_dt)
         if resumed is not None and is_host0:
             logger.info("resumed at step %d", resumed)
         resumed_step = resumed
@@ -210,8 +216,18 @@ def run_training(state: TrainState,
             # span duration is the EXACT float the ledger booked — the
             # critical-path reconciliation (obs/critical.py) depends on
             # the two streams agreeing bitwise, not approximately
-            obs.span_add("restore", restore_dt, step=resumed,
-                         resumed_step=resumed)
+            if peer_served:
+                obs.span_add("peer_restore", restore_dt, step=resumed,
+                             resumed_step=resumed)
+                _pmeta = getattr(ckpt_manager, "last_peer_restore",
+                                 None) or {}
+                obs.emit("peer_restore", step=resumed,
+                         restore_s=restore_dt,
+                         bytes=_pmeta.get("bytes"),
+                         from_slice=_pmeta.get("from_slice"))
+            else:
+                obs.span_add("restore", restore_dt, step=resumed,
+                             resumed_step=resumed)
         if obs is not None and resumed is not None:
             obs.emit("resume", step=resumed, resumed_step=resumed)
         # attempt metadata for Result.attempt_log (rayint/trainer.py);
@@ -431,7 +447,9 @@ def run_training(state: TrainState,
                 # device step time. Pure host floats, no sync.
                 _now = time.perf_counter()
                 _booked = (ledger.eval_ckpt_stall_s + ledger.compile_s
-                           + ledger.restore_s + ledger.fast_forward_s)
+                           + ledger.restore_s + ledger.fast_forward_s
+                           + ledger.ckpt_async_s
+                           + ledger.peer_restore_s)
                 _iter_v = max(_now - _obs_prev[0] - wait_s
                               - (_booked - _obs_prev[1]), 0.0)
                 obs.note_step(global_step, _iter_v, wait_s)
@@ -507,22 +525,47 @@ def run_training(state: TrainState,
             if ckpt_manager is not None and ckpt_every and \
                     global_step % ckpt_every == 0:
                 m_host = _fetch_metrics(m)
-                t_save0 = time.perf_counter()
-                _ck0 = ledger.eval_ckpt_stall_s
-                try:
-                    with paused(meter), paused(ledger), \
-                            allow_transfers():
-                        ckpt_manager.save(global_step, save_view(state),
-                                          metrics=m_host)
-                finally:
+                if getattr(ckpt_manager, "async_commit", False):
+                    # async-commit save (ISSUE 18): the loop blocks only
+                    # for the device→host snapshot + enqueue — booked as
+                    # ckpt_async_s, the residual blocking cost of async
+                    # checkpointing. The storage serialize runs on the
+                    # committer thread behind the write-ahead marker and
+                    # lands as a ckpt_commit EVENT, never loop time.
+                    t_save0 = time.perf_counter()
+                    snap_dt = 0.0
+                    try:
+                        with paused(meter), allow_transfers():
+                            ckpt_manager.save(global_step,
+                                              save_view(state),
+                                              metrics=m_host)
+                    finally:
+                        snap_dt = time.perf_counter() - t_save0
+                        ledger.note("ckpt_async_s", snap_dt)
+                        if obs is not None:
+                            obs.span_add("ckpt_snapshot", snap_dt,
+                                         step=global_step, forced=False)
                     if obs is not None:
-                        obs.span_add("ckpt_save",
-                                     ledger.eval_ckpt_stall_s - _ck0,
-                                     step=global_step, forced=False)
-                if obs is not None:
-                    obs.emit("ckpt_save", step=global_step,
-                             save_s=time.perf_counter() - t_save0,
-                             forced=False)
+                        obs.emit("ckpt_snapshot", step=global_step,
+                                 snapshot_s=snap_dt, forced=False)
+                else:
+                    t_save0 = time.perf_counter()
+                    _ck0 = ledger.eval_ckpt_stall_s
+                    try:
+                        with paused(meter), paused(ledger), \
+                                allow_transfers():
+                            ckpt_manager.save(global_step,
+                                              save_view(state),
+                                              metrics=m_host)
+                    finally:
+                        if obs is not None:
+                            obs.span_add("ckpt_save",
+                                         ledger.eval_ckpt_stall_s - _ck0,
+                                         step=global_step, forced=False)
+                    if obs is not None:
+                        obs.emit("ckpt_save", step=global_step,
+                                 save_s=time.perf_counter() - t_save0,
+                                 forced=False)
             if fault_injector is not None:
                 # after the step's bookkeeping AND its scheduled save, so
                 # kind=ckpt_truncate at step k tears the step-k save
@@ -573,16 +616,29 @@ def run_training(state: TrainState,
             obs.emit("epoch_end", step=global_step, epoch=epoch)
         last_metrics = epoch_metrics
         if ckpt_manager is not None:
-            _ck0 = ledger.eval_ckpt_stall_s
-            try:
-                with paused(ledger), allow_transfers():
-                    ckpt_manager.save(global_step, save_view(state),
-                                      metrics=m_host)
-            finally:
-                if obs is not None:
-                    obs.span_add("ckpt_save",
-                                 ledger.eval_ckpt_stall_s - _ck0,
-                                 step=global_step, forced=False)
+            if getattr(ckpt_manager, "async_commit", False):
+                t_save0 = time.perf_counter()
+                try:
+                    with allow_transfers():
+                        ckpt_manager.save(global_step, save_view(state),
+                                          metrics=m_host)
+                finally:
+                    snap_dt = time.perf_counter() - t_save0
+                    ledger.note("ckpt_async_s", snap_dt)
+                    if obs is not None:
+                        obs.span_add("ckpt_snapshot", snap_dt,
+                                     step=global_step, forced=False)
+            else:
+                _ck0 = ledger.eval_ckpt_stall_s
+                try:
+                    with paused(ledger), allow_transfers():
+                        ckpt_manager.save(global_step, save_view(state),
+                                          metrics=m_host)
+                finally:
+                    if obs is not None:
+                        obs.span_add("ckpt_save",
+                                     ledger.eval_ckpt_stall_s - _ck0,
+                                     step=global_step, forced=False)
         if report_fn is not None:
             report_fn(epoch_metrics)
     finally:

@@ -41,8 +41,16 @@ from gke_ray_train_tpu.models.config import ModelConfig
 # BENCH_MODE=recovery, ckpt_save_s on Preempted — and are unified here:
 # every attempt's wall-clock decomposes into exactly these buckets, and
 # tests assert they reconcile (sum == attempt wall within tolerance).
+# ckpt_async_s is the RESIDUAL blocking time of an async-commit save
+# (device→host snapshot + committer enqueue; the serialize-to-storage
+# tail runs in the background and never appears here) and
+# peer_restore_s is a restore served from a living peer slice's hot
+# state instead of storage — the two terms ISSUE 18 drives toward
+# zero-cost checkpointing/recovery. A sync save books the classic
+# eval_ckpt_stall_s; a storage restore books restore_s.
 LEDGER_TERMS = ("compile_s", "restore_s", "fast_forward_s",
-                "data_stall_s", "eval_ckpt_stall_s", "step_s", "lost_s")
+                "data_stall_s", "eval_ckpt_stall_s", "ckpt_async_s",
+                "peer_restore_s", "step_s", "lost_s")
 
 
 @dataclasses.dataclass
@@ -67,6 +75,8 @@ class GoodputLedger:
     fast_forward_s: float = 0.0
     data_stall_s: float = 0.0
     eval_ckpt_stall_s: float = 0.0
+    ckpt_async_s: float = 0.0
+    peer_restore_s: float = 0.0
     step_s: float = 0.0
     lost_s: float = 0.0
     _pause_t0: Optional[float] = None
@@ -98,7 +108,8 @@ class GoodputLedger:
             return
         self.resume()
         covered = (self.compile_s + self.restore_s + self.fast_forward_s
-                   + self.data_stall_s + self.eval_ckpt_stall_s)
+                   + self.data_stall_s + self.eval_ckpt_stall_s
+                   + self.ckpt_async_s + self.peer_restore_s)
         self.step_s = max(float(loop_wall_s) - covered, 0.0)
         self._closed = True
 

@@ -332,8 +332,25 @@ def train_loop_per_worker(config: dict):
         # save whose loss is not among the best, and the
         # corrupt-checkpoint fallback (ckpt/manager.py) needs an
         # earlier restorable step to survive an interrupted latest save
-        mgr = CheckpointManager(sft_dir, max_to_keep=2,
-                                score_attribute=None)
+        # goodput knobs (ASYNC_CKPT / PEER_REPLICATION /
+        # CKPT_COMMIT_TIMEOUT_S): same dual-read + semantics as the
+        # pretrain entry point — the RESUME manager commits async and
+        # replicates to the peer slice; the export manager below stays
+        # synchronous (a final artifact has no goodput to protect)
+        def _goodput_flag(key):
+            return str(config.get(key, os.environ.get(key, "0"))
+                       ).strip().lower() not in ("", "0", "false", "no")
+        peer = None
+        if _goodput_flag("PEER_REPLICATION"):
+            from gke_ray_train_tpu.ckpt.peer import PeerReplicator
+            peer = PeerReplicator.from_env()
+        mgr = CheckpointManager(
+            sft_dir, max_to_keep=2, score_attribute=None,
+            async_commit=_goodput_flag("ASYNC_CKPT"),
+            commit_timeout_s=float(config.get(
+                "CKPT_COMMIT_TIMEOUT_S",
+                os.environ.get("CKPT_COMMIT_TIMEOUT_S", "120"))),
+            peer=peer)
 
     group_by_length = bool(config.get("GROUP_BY_LENGTH", False))
     if group_by_length and packing:
@@ -450,8 +467,12 @@ def train_loop_per_worker(config: dict):
         # restore O(one layer) at a time at 70B scale.
         from gke_ray_train_tpu.ckpt.convert import (
             unstack_for_export, write_sidecar)
+        # explicitly synchronous even under ASYNC_CKPT=1: a final
+        # export has no goodput to protect, and the save must be
+        # durable before write_sidecar runs
         export_mgr = CheckpointManager(final_dir + "_orbax", max_to_keep=1,
-                                       score_attribute=None)
+                                       score_attribute=None,
+                                       async_commit=False, peer=False)
         export_mgr.save(int(jax.device_get(state.step)),
                         unstack_for_export(merged), force=True)
         export_mgr.wait()

@@ -169,7 +169,26 @@ def train_loop_per_worker(config: dict):
     # garbage-collect a grace-window preemption save whose loss is not
     # among the best, and the corrupt-checkpoint fallback needs an
     # earlier restorable step to survive an interrupted latest save
-    mgr = CheckpointManager(run_dir, max_to_keep=2, score_attribute=None)
+    # goodput knobs: ASYNC_CKPT=1 moves the storage commit behind a
+    # write-ahead marker on a background thread (the loop blocks only
+    # for the device→host snapshot); PEER_REPLICATION=1 streams every
+    # snapshot to the peer slice's hot store so a slice eviction
+    # resumes without a storage read. Config-first with env fallback —
+    # the SERVE_AFTER_TRAIN dual-read idiom.
+    def _goodput_flag(key):
+        return str(config.get(key, os.environ.get(key, "0"))
+                   ).strip().lower() not in ("", "0", "false", "no")
+    peer = None
+    if _goodput_flag("PEER_REPLICATION"):
+        from gke_ray_train_tpu.ckpt.peer import PeerReplicator
+        peer = PeerReplicator.from_env()
+    mgr = CheckpointManager(
+        run_dir, max_to_keep=2, score_attribute=None,
+        async_commit=_goodput_flag("ASYNC_CKPT"),
+        commit_timeout_s=float(config.get(
+            "CKPT_COMMIT_TIMEOUT_S",
+            os.environ.get("CKPT_COMMIT_TIMEOUT_S", "120"))),
+        peer=peer)
     if ctx.is_host0():
         # tokenizer beside the checkpoints: the run dir alone is enough
         # to decode/resume (reference saves the tokenizer with the

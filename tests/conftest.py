@@ -71,6 +71,15 @@ def fsdp_mesh(devices):
 
 
 @pytest.fixture(scope="session")
+def hybrid_mesh(devices):
+    """2 data x 4 fsdp with the data axis laid across 2 emulated slices
+    (the DCN-outermost hybrid layout). ONE session build shared by the
+    DCN sync drills (test_dcn) and the peer/goodput recovery drills —
+    the per-arm mesh rebuilds were pure tier-1 wall."""
+    return build_mesh(MeshConfig(data=2, fsdp=4, num_slices=2), devices)
+
+
+@pytest.fixture(scope="session")
 def tp_mesh(devices):
     """2 fsdp x 2 model x 2 context — every parallelism axis live."""
     return build_mesh(MeshConfig(data=1, fsdp=2, model=2, context=2), devices)

@@ -40,6 +40,7 @@ def test_goodput_ledger_schema_pinned():
         LEDGER_TERMS, finish_ledger, sum_ledgers)
     assert LEDGER_TERMS == ("compile_s", "restore_s", "fast_forward_s",
                             "data_stall_s", "eval_ckpt_stall_s",
+                            "ckpt_async_s", "peer_restore_s",
                             "step_s", "lost_s")
     # reconciliation identity: terms sum to wall-clock by construction
     led = finish_ledger({"compile_s": 1.0, "step_s": 2.5}, 5.0)

@@ -59,12 +59,28 @@ def _drill_plan(dcn_sync, *, dcn_compress="none", grad_accum=1, **kw):
     return ExecutionPlan.from_kwargs(**base)
 
 
+# the session-scoped 2-slice mesh (tests/conftest.py::hybrid_mesh),
+# bound once per module by the autouse fixture below: every drill arm
+# uses the SAME mesh object (the arms differ in sync/compress/accum,
+# never in topology), instead of rebuilding it per call
+_MESH: list = []
+
+
+@pytest.fixture(autouse=True)
+def _bind_hybrid_mesh(hybrid_mesh):
+    _MESH[:] = [hybrid_mesh]
+
+
+def _drill_mesh(plan):
+    return _MESH[0] if _MESH else plan.build_mesh(jax.devices())
+
+
 def _run_drill(dcn_sync, *, dcn_compress="none", grad_accum=1, steps=4,
                with_report=False, cfg=None):
     cfg = cfg or _drill_cfg()
     plan = _drill_plan(dcn_sync, dcn_compress=dcn_compress,
                        grad_accum=grad_accum)
-    mesh = plan.build_mesh(jax.devices())
+    mesh = _drill_mesh(plan)
     opt = make_optimizer(1e-3)
     state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
     step = make_train_step(cfg, opt, mesh=mesh, plan=plan)
@@ -139,7 +155,7 @@ def test_hier_psum_vjp_identity():
     from gke_ray_train_tpu.parallel.hierarchical import (
         SliceTopology, hier_psum)
 
-    mesh = _drill_plan("flat").build_mesh(jax.devices())
+    mesh = _drill_mesh(_drill_plan("flat"))
     topo = SliceTopology(num_slices=2, data=2, fsdp=4)
     x = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
 
@@ -157,7 +173,7 @@ def test_slice_topology_contract():
     from gke_ray_train_tpu.parallel.hierarchical import (
         HierSyncUnsupported, SliceTopology, slice_topology)
 
-    mesh = _drill_plan("flat").build_mesh(jax.devices())
+    mesh = _drill_mesh(_drill_plan("flat"))
     topo = slice_topology(mesh, 2)
     assert topo.ici_size == 4 and topo.data_intra == 1
     assert topo.intra_groups == ((0,), (1,))

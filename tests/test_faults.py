@@ -414,6 +414,62 @@ def test_result_attempt_metadata_on_clean_run():
     assert res.goodput["wall_s"] == g["wall_s"]
 
 
+# ---- marker robustness (ISSUE 18 satellites) -------------------------
+
+def test_current_pool_unreadable_marker_raises_loudly(tmp_path):
+    """A present-but-unreadable pool marker means the real pool size is
+    indeterminate — silently assuming the full pool would re-form the
+    mesh on devices that may not exist. Must raise, not return None."""
+    from gke_ray_train_tpu.testing.faults import (
+        POOL_MARKER_NAME, current_pool, reset_pool)
+    reset_pool()
+    d = str(tmp_path / "ckpt")
+    os.makedirs(d)
+    # no marker at all: genuinely "never shrunk" — None is correct
+    assert current_pool(d) is None
+    with open(os.path.join(d, POOL_MARKER_NAME), "w") as f:
+        f.write("not-a-number")
+    with pytest.raises(RuntimeError, match="unreadable"):
+        current_pool(d)
+    # repairing the marker restores normal reads
+    with open(os.path.join(d, POOL_MARKER_NAME), "w") as f:
+        f.write("4")
+    assert current_pool(d) == 4
+    reset_pool()
+
+
+def test_already_fired_survives_torn_marker_line(tmp_path):
+    """The attempt that fires a kill fault is usually killed mid-append,
+    which can leave the fired-marker's last line a strict prefix of the
+    key. That fault DID fire — a fresh attempt re-firing it would loop
+    the drill forever. Torn tail => treated as fired, never a crash."""
+    from gke_ray_train_tpu.testing.faults import MARKER_NAME
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), score_attribute=None,
+                            async_save=False)
+    inj = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                        rank=0, ckpt_manager=mgr)
+    with pytest.raises(InjectedKill):
+        inj.on_step(2)
+    marker = os.path.join(str(mgr.directory), MARKER_NAME)
+    full_key = open(marker).read().strip()
+    # tear the marker: the key's last line cut mid-write, no newline
+    with open(marker, "w") as f:
+        f.write(full_key[: len(full_key) // 2])
+    reset_fired()  # "new process"
+    inj2 = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                         rank=0, ckpt_manager=mgr)
+    inj2.on_step(2)  # torn line counts as fired: no re-fire, no crash
+    # present-but-unreadable marker (here: a directory) also errs on
+    # the at-most-once side instead of crashing or double-firing
+    os.remove(marker)
+    os.makedirs(marker)
+    reset_fired()
+    inj3 = FaultInjector(parse_fault_spec("rank=0:kind=kill:step=2"),
+                         rank=0, ckpt_manager=mgr)
+    inj3.on_step(2)
+    mgr.close()
+
+
 # ---- multi-process drill (tests/_multihost.py path) ------------------
 
 @pytest.mark.slow

@@ -1,0 +1,738 @@
+"""Goodput ≥99% (ISSUE 18): async checkpointing behind a write-ahead
+commit, peer-slice hot-state replication, and the chaos drill that
+proves the two together keep ``goodput_frac`` at or above 0.99 while a
+sync-checkpoint baseline sits well below it.
+
+The write-ahead protocol (``ckpt/manager.py``): the loop's save is ONE
+device→host snapshot + enqueue; a background committer serializes each
+snapshot behind a ``COMMITTING.<step>`` marker and promotes it to
+``COMMITTED.<step>`` only after the data is durable. A death anywhere
+inside the commit leaves the COMMITTING-without-COMMITTED signature and
+recovery treats the step as never saved — drilled end-to-end here with
+the ``kill_during_commit`` FAULT_SPEC verb, bitwise against an
+uninterrupted run.
+
+Peer hot state (``ckpt/peer.py``): every snapshot streams to the ring
+neighbor slice, so a ``slice_evict`` resumes from the survivor's memory
+with NO storage read — also bitwise against the cold-restore path.
+
+The headline numbers are pinned as obs-diff regression fixtures
+(``tests/regressions/goodput_chaos_{async,sync}.json``) — re-record
+after an INTENTIONAL change with ``REGRESSION_UPDATE=1``.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gke_ray_train_tpu.ckpt import CheckpointManager
+from gke_ray_train_tpu.ckpt.manager import CheckpointCommitError
+from gke_ray_train_tpu.ckpt.peer import (
+    PeerReplicator, round_dcn_bytes, state_replica_nbytes)
+from gke_ray_train_tpu.ckpt.peer import reset as peer_reset
+from gke_ray_train_tpu.obs.diff import diff_flat, write_regression
+from gke_ray_train_tpu.parallel.placement import make_place_batch
+from gke_ray_train_tpu.plan import ExecutionPlan
+from gke_ray_train_tpu.rayint import FailureConfig, JaxTrainer, RunConfig
+from gke_ray_train_tpu.rayint.elastic import maybe_replan
+from gke_ray_train_tpu.testing.faults import (
+    FaultInjector, parse_fault_spec, reset_fired, reset_pool)
+from gke_ray_train_tpu.train import (
+    make_optimizer, make_train_state, make_train_step, preempt)
+from gke_ray_train_tpu.train.loop import run_training
+from gke_ray_train_tpu.train.metrics import LEDGER_TERMS
+
+REGRESSIONS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "regressions")
+BUDGETS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "budgets")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    """Fault + pool registries and the peer hot store are process-global
+    by design; none of it may leak between tests."""
+    monkeypatch.delenv("FAULT_SPEC", raising=False)
+    monkeypatch.delenv("ASYNC_CKPT", raising=False)
+    monkeypatch.delenv("PEER_REPLICATION", raising=False)
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    peer_reset()
+    yield
+    reset_fired()
+    reset_pool()
+    preempt.reset()
+    preempt.uninstall()
+    peer_reset()
+
+
+def _small_state():
+    return {"w": jnp.arange(512, dtype=jnp.float32),
+            "m": jnp.ones((4, 8), jnp.float32) * 3.0,
+            "step": jnp.asarray(7, jnp.int32)}
+
+
+def _marker(root, kind, step):
+    return os.path.join(str(root), f"{kind}.{step}")
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------
+# the write-ahead commit protocol, at the manager level
+# ---------------------------------------------------------------------
+
+def test_async_save_returns_fast_and_commits_in_background(tmp_path):
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, score_attribute=None, async_commit=True,
+                            storage_delay_s=0.5)
+    state = _small_state()
+    t0 = time.perf_counter()
+    assert mgr.save(1, state) is True
+    snapshot_dt = time.perf_counter() - t0
+    # the loop-facing half blocked only for the device→host snapshot,
+    # never the (emulated 0.5s) storage round-trip
+    assert snapshot_dt < 0.4
+    # the commit is still behind its write-ahead marker: no COMMITTED
+    # record can exist yet (the committer sleeps the storage delay
+    # before serializing)
+    assert not os.path.exists(_marker(d, "COMMITTED", 1))
+    mgr.wait()
+    assert mgr.commits_done == 1
+    assert os.path.exists(_marker(d, "COMMITTED", 1))
+    assert not os.path.exists(_marker(d, "COMMITTING", 1))
+    assert mgr.latest_step() == 1
+    out, step = mgr.restore_if_available(jax.tree.map(jnp.zeros_like,
+                                                      state))
+    assert step == 1
+    _assert_tree_equal(out, state)
+    mgr.close()
+
+
+def test_wait_surfaces_background_commit_failure(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), score_attribute=None,
+                            async_commit=True)
+
+    def exploding_save(*a, **k):
+        raise RuntimeError("emulated storage outage")
+    mgr._mgr.save = exploding_save
+    assert mgr.save(1, _small_state()) is True
+    with pytest.raises(CheckpointCommitError):
+        mgr.wait()
+    mgr.close()
+
+
+def test_tear_mid_commit_leaves_committing_without_committed(tmp_path):
+    d = str(tmp_path / "ckpt")
+    mgr = CheckpointManager(d, score_attribute=None, async_commit=True,
+                            storage_delay_s=0.2)
+    state = _small_state()
+    mgr.save(1, state)
+    mgr.wait()
+    mgr.save(2, state)
+    torn = mgr.tear_mid_commit()
+    assert torn == 2 and mgr.last_torn_step == 2
+    # the on-disk signature of a mid-commit death: write-ahead record
+    # present, durable record absent
+    assert os.path.exists(_marker(d, "COMMITTING", 2))
+    assert not os.path.exists(_marker(d, "COMMITTED", 2))
+    # the torn manager is 'dead', like the process it stands in for
+    assert mgr.save(3, state) is False
+    mgr.close()
+
+    # the resumed attempt: step 2 'never existed'
+    mgr2 = CheckpointManager(d, score_attribute=None, async_commit=True)
+    out, step = mgr2.restore_if_available(
+        jax.tree.map(jnp.zeros_like, state))
+    assert step == 1
+    _assert_tree_equal(out, state)
+    assert mgr2.last_restore_source == "storage"
+    # the purge consumed the torn step: marker gone, directory (if the
+    # kill landed after partial data hit disk) quarantined — and the
+    # step is never offered again
+    assert not os.path.exists(_marker(d, "COMMITTING", 2))
+    assert not os.path.exists(os.path.join(d, "2"))
+    assert mgr2.latest_step() == 1
+    mgr2.close()
+
+
+def test_tear_mid_commit_requires_an_inflight_commit(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), score_attribute=None,
+                            async_commit=True)
+    mgr.save(1, _small_state())
+    mgr.wait()
+    with pytest.raises(RuntimeError, match="no in-flight commit"):
+        mgr.tear_mid_commit()
+    mgr.close()
+    mgr_sync = CheckpointManager(str(tmp_path / "sync"),
+                                 score_attribute=None, async_save=False)
+    with pytest.raises(RuntimeError, match="ASYNC_CKPT"):
+        mgr_sync.tear_mid_commit()
+    mgr_sync.close()
+
+
+def test_sync_mode_suspect_excluded_then_healed_on_verify(tmp_path):
+    """Sync managers keep the verify-first contract: a step whose
+    marker pair says 'mid-commit' is never OFFERED (latest_step), but
+    the restore walk still verifies it by restoring — a durable save
+    whose marker flush died with the process is healed, not lost."""
+    d = str(tmp_path / "ckpt")
+    state = _small_state()
+    mgr = CheckpointManager(d, score_attribute=None, async_save=False,
+                            max_to_keep=4)
+    mgr.save(2, state)
+    two = jax.tree.map(lambda x: x + 1, state)
+    mgr.save(4, two)
+    mgr.wait()
+    mgr.close()
+    # forge the mid-commit signature on step 4
+    os.remove(_marker(d, "COMMITTED", 4))
+    with open(_marker(d, "COMMITTING", 4), "w") as f:
+        f.write("COMMITTING step=4\n")
+
+    mgr2 = CheckpointManager(d, score_attribute=None, async_save=False,
+                             max_to_keep=4)
+    assert mgr2.latest_step() == 2          # the suspect is not offered
+    out, step = mgr2.restore_if_available(
+        jax.tree.map(jnp.zeros_like, state))
+    assert step == 4                        # ... but it verified fine
+    _assert_tree_equal(out, two)
+    # and the record was healed for the next resume
+    assert os.path.exists(_marker(d, "COMMITTED", 4))
+    assert not os.path.exists(_marker(d, "COMMITTING", 4))
+    assert mgr2.latest_step() == 4
+    mgr2.close()
+
+
+def test_quarantined_step_reappearing_is_never_offered(tmp_path):
+    """Satellite drill: step N was quarantined as corrupt; a second
+    crash at the SAME step leaves a fresh partial ``N`` directory (and
+    its write-ahead marker) on disk. ``latest_step()`` must not offer
+    N, and the resume must come back from N-1 — a re-quarantine loop
+    on the same bad step would otherwise shadow the good checkpoint
+    forever."""
+    d = str(tmp_path / "ckpt")
+    state = _small_state()
+    mgr = CheckpointManager(d, score_attribute=None, async_save=False,
+                            max_to_keep=4)
+    mgr.save(2, state)
+    mgr.save(4, jax.tree.map(lambda x: x + 1, state))
+    mgr.wait()
+    mgr.close()
+
+    # first crash: step 4's data is torn; the resume quarantines it
+    biggest, size = None, -1
+    for root, _, files in os.walk(os.path.join(d, "4")):
+        for f in files:
+            p = os.path.join(root, f)
+            if os.path.getsize(p) > size:
+                biggest, size = p, os.path.getsize(p)
+    with open(biggest, "r+b") as f:
+        f.truncate(max(size // 2, 1))
+    mgr2 = CheckpointManager(d, score_attribute=None, async_save=False,
+                             max_to_keep=4)
+    out, step = mgr2.restore_if_available(
+        jax.tree.map(jnp.zeros_like, state))
+    assert step == 2
+    assert os.path.isdir(os.path.join(d, "4.corrupt"))
+    mgr2.close()
+
+    # second crash at the same step: the retried attempt re-reached
+    # step 4, started a save, and died mid-commit — a partial "4"
+    # REAPPEARS next to its quarantined namesake
+    os.makedirs(os.path.join(d, "4"))
+    with open(os.path.join(d, "4", "_PARTIAL"), "wb") as f:
+        f.write(b"\x00" * 64)
+    with open(_marker(d, "COMMITTING", 4), "w") as f:
+        f.write("COMMITTING step=4\n")
+
+    for async_commit in (True, False):
+        mgr3 = CheckpointManager(d, score_attribute=None,
+                                 async_commit=async_commit,
+                                 async_save=False, max_to_keep=4)
+        assert mgr3.latest_step() == 2, (
+            f"reappeared quarantined step offered (async={async_commit})")
+        out, step = mgr3.restore_if_available(
+            jax.tree.map(jnp.zeros_like, state))
+        assert step == 2
+        _assert_tree_equal(out, state)
+        mgr3.close()
+
+
+# ---------------------------------------------------------------------
+# peer-slice hot state, at the replicator level
+# ---------------------------------------------------------------------
+
+def test_peer_replicate_restore_roundtrip_and_eviction():
+    rep = PeerReplicator(num_slices=2)
+    state = _small_state()
+    host = jax.device_get(state)
+    meta = rep.replicate("runA", 3, host)
+    nbytes = state_replica_nbytes(host)
+    assert meta["bytes"] == rep.last_round_bytes == 2 * nbytes
+    assert rep.last_round_bytes == round_dcn_bytes(host, 2)
+    assert rep.holders("runA") == {0: 3, 1: 3}
+    # one slice dies with its memory; the survivor still serves
+    assert rep.evict_slice("runA", 1) is True
+    assert rep.peek("runA") == 3
+    out, rmeta = rep.restore("runA", state)
+    assert rmeta["step"] == 3 and rmeta["from_slice"] == 0
+    _assert_tree_equal(out, state)            # uncompressed = bitwise
+    # a template whose tree changed shape is refused loudly
+    with pytest.raises(ValueError, match="tree structure"):
+        rep.restore("runA", {"w": state["w"]})
+    # the last holder dies: hot state is gone, storage must serve
+    assert rep.evict_slice("runA", 0) is True
+    assert rep.peek("runA") is None
+    with pytest.raises(LookupError):
+        rep.restore("runA", state)
+
+
+def test_peer_bf16_compression_halves_float_stream_bytes():
+    rep = PeerReplicator(num_slices=2, compress="bf16")
+    host = jax.device_get(_small_state())
+    meta = rep.replicate("runC", 1, host)
+    f32 = host["w"].nbytes + host["m"].nbytes
+    ints = host["step"].nbytes
+    assert meta["bytes"] == 2 * (f32 // 2 + ints)
+    out, _ = rep.restore("runC", _small_state())
+    # lossy stream: close, deliberately NOT bitwise
+    np.testing.assert_allclose(np.asarray(out["m"]), np.asarray(host["m"]),
+                               rtol=1e-2)
+
+
+def test_peer_dcn_bytes_matches_checked_in_budget_pin():
+    """The live replicator's byte counter vs the eval_shape oracle the
+    budget JSON records (``perf/budget.py::peer_replication_bytes``) —
+    tolerance 0: the stream is a pure function of the state tree's
+    shapes × dtypes × num_slices, so any drift is a protocol change."""
+    from gke_ray_train_tpu.perf.budget import (
+        peer_replication_bytes, preset_model_cfg)
+    with open(os.path.join(BUDGETS, "tiny_hybrid_2x4_hier.json")) as f:
+        recorded = json.load(f)["peer_dcn_bytes"]
+    assert peer_replication_bytes("tiny_hybrid_2x4_hier") == recorded
+    # now move the actual bytes: the concrete budget-preset state
+    cfg = preset_model_cfg("tiny_hybrid_2x4_hier")
+    opt = make_optimizer(1e-3)
+    state = make_train_state(cfg, opt, jax.random.key(0))
+    rep = PeerReplicator(num_slices=2)
+    meta = rep.replicate("runPin", 1, jax.device_get(state))
+    assert meta["bytes"] == rep.last_round_bytes == recorded
+
+
+# ---------------------------------------------------------------------
+# kill_during_commit, end to end through JaxTrainer
+# ---------------------------------------------------------------------
+
+def _wal_batches(n):
+    out = []
+    for i in range(n):
+        k = jax.random.key(2000 + i)
+        out.append({
+            "inputs": jax.random.randint(k, (2, 8), 0, 128),
+            "targets": jax.random.randint(k, (2, 8), 0, 128),
+            "weights": jnp.ones((2, 8), jnp.float32),
+        })
+    return out
+
+
+def _wal_worker(ckpt_dir, setup, batches_all, *, losses,
+                storage_delay_s=0.05):
+    cfg, opt, state0, step_fn = setup
+
+    def worker(config):
+        def recording_step(st, batch):
+            st2, m = step_fn(st, batch)
+            losses[int(jax.device_get(st.step)) + 1] = float(
+                jax.device_get(m["loss"]))
+            return st2, m
+        mgr = CheckpointManager(ckpt_dir, max_to_keep=4,
+                                score_attribute=None, async_commit=True,
+                                storage_delay_s=storage_delay_s)
+        try:
+            final, metrics = run_training(
+                state0, recording_step, lambda epoch: iter(batches_all),
+                epochs=1, ckpt_manager=mgr, ckpt_every=2)
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **metrics}
+    return worker
+
+
+def test_kill_during_commit_resumes_previous_step_bitwise(
+        tmp_path, monkeypatch, tiny_train_setup):
+    """The acceptance drill of tentpole (a): a kill mid-commit of step
+    N resumes from N-1's cadence save — never a torn N — and the
+    resumed trajectory is BITWISE identical to an uninterrupted run."""
+    batches_all = _wal_batches(8)
+    ref_losses = {}
+    ref = JaxTrainer(
+        _wal_worker(str(tmp_path / "ref"), tiny_train_setup,
+                    batches_all, losses=ref_losses),
+        use_ray=False).fit()
+    assert ref.error is None and ref.metrics["final_step"] == 8
+
+    losses = {}
+    monkeypatch.setenv("FAULT_SPEC",
+                       "rank=0:kind=kill_during_commit:step=4")
+    res = JaxTrainer(
+        _wal_worker(str(tmp_path / "chaos"), tiny_train_setup,
+                    batches_all, losses=losses),
+        use_ray=False,
+        run_config=RunConfig(
+            failure_config=FailureConfig(max_failures=1))).fit()
+    assert res.error is None and res.attempts == 2
+    assert "injected kill during commit of step 4" in \
+        res.attempt_log[0]["error"]
+    # the torn step 4 'never existed': the retry resumed from the
+    # PREVIOUS committed cadence save, not a torn 4
+    assert res.attempt_log[1]["resumed_step"] == 2
+    assert res.metrics["final_step"] == 8
+    # both attempts paid only the snapshot residual, never a sync stall
+    g = res.goodput
+    assert g["ckpt_async_s"] > 0.0 and g["eval_ckpt_stall_s"] == 0.0
+    assert res.attempt_log[1]["goodput"]["restore_s"] > 0.0
+    # bitwise: every step's loss — including the replayed 3..4 — equals
+    # the uninterrupted run's
+    assert losses == ref_losses
+    assert res.metrics["loss"] == ref.metrics["loss"]
+    # no write-ahead debris survives the run
+    d = str(tmp_path / "chaos")
+    assert not [f for f in os.listdir(d) if f.startswith("COMMITTING.")]
+
+
+# ---------------------------------------------------------------------
+# slice_evict → resume from the peer slice, end to end
+# ---------------------------------------------------------------------
+
+P_STEPS = 10
+P_B, P_S = 8, 16
+
+
+def _peer_batches(epoch):
+    for i in range(P_STEPS):
+        rng = np.random.default_rng(epoch * 100 + i)
+        yield {"inputs": rng.integers(0, 64, (P_B, P_S)).astype(np.int32),
+               "targets": rng.integers(0, 64, (P_B, P_S)).astype(np.int32),
+               "weights": np.ones((P_B, P_S), np.float32)}
+
+
+def _peer_worker(ckpt_dir, *, peer, losses, sources, fault_spec=None):
+    """The elastic-drill worker shape (plan from config, mesh on the
+    surviving pool) with a peer replicator bound to the manager."""
+    from gke_ray_train_tpu.models import tiny
+    cfg = tiny(vocab_size=64, d_model=32, n_layers=1, n_heads=2,
+               n_kv_heads=2, d_ff=64, dtype="float32",
+               param_dtype="float32")
+    opt = make_optimizer(1e-3)
+
+    def worker(config):
+        plan, devs = maybe_replan(ExecutionPlan.resolve(config),
+                                  config=config)
+        mesh = plan.build_mesh(devs)
+        state = make_train_state(cfg, opt, jax.random.key(0), mesh=mesh)
+        step_fn = make_train_step(cfg, opt, mesh=mesh, donate=False)
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=2, score_attribute=None,
+            async_save=False,
+            peer=PeerReplicator(num_slices=2) if peer else False)
+        inj = None
+        if fault_spec:
+            inj = FaultInjector(parse_fault_spec(fault_spec), rank=0,
+                                ckpt_manager=mgr)
+
+        def recording_step(st, batch):
+            st2, m = step_fn(st, batch)
+            losses[int(jax.device_get(st.step)) + 1] = float(
+                jax.device_get(m["loss"]))
+            return st2, m
+
+        try:
+            final, metrics = run_training(
+                state, recording_step, _peer_batches, epochs=1,
+                ckpt_manager=mgr, ckpt_every=2,
+                place_batch=make_place_batch(mesh), fault_injector=inj)
+        finally:
+            sources.append((mgr.last_restore_source,
+                            mgr.last_peer_restore))
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **{
+            k: v for k, v in metrics.items() if isinstance(v, float)}}
+    return worker
+
+
+def _peer_config():
+    return {"MESH_DATA": 2, "MESH_FSDP": -1, "NUM_SLICES": 2,
+            "PER_DEVICE_TRAIN_BATCH_SIZE": 1, "MAX_SEQ_LENGTH": P_S,
+            "TOPOLOGY": "cpu-8", "ELASTIC": "1"}
+
+
+def test_slice_evict_resumes_from_peer_hot_state_bitwise(
+        tmp_path, monkeypatch):
+    """Tentpole (b) acceptance: after a slice eviction the survivor's
+    hot replica serves the resume — peer_restore_s booked, restore_s
+    zero, NO storage restore — and the resumed loss trajectory is
+    bitwise identical to the cold (storage) restore path's."""
+    monkeypatch.setenv("NUM_SLICES", "2")
+    evict_at = 5
+    runs = {}
+    for arm in ("peer", "cold"):
+        reset_fired()
+        reset_pool()
+        preempt.reset()
+        losses, sources = {}, []
+        res = JaxTrainer(
+            _peer_worker(str(tmp_path / arm), peer=(arm == "peer"),
+                         losses=losses, sources=sources,
+                         fault_spec=(f"rank=0:kind=slice_evict"
+                                     f":step={evict_at}")),
+            train_loop_config=_peer_config(), use_ray=False,
+            run_config=RunConfig(failure_config=FailureConfig(
+                max_failures=0, max_preemptions=2))).fit()
+        assert res.error is None, (arm, res.error)
+        assert res.preemptions == 1 and res.attempts == 2
+        assert res.metrics["final_step"] == P_STEPS
+        runs[arm] = (res, losses, sources)
+
+    p_res, p_losses, p_sources = runs["peer"]
+    c_res, c_losses, c_sources = runs["cold"]
+    # both arms grace-saved at the eviction step and resumed from it
+    assert p_res.attempt_log[1]["resumed_step"] == evict_at
+    assert c_res.attempt_log[1]["resumed_step"] == evict_at
+    # the peer arm's resume came from the surviving slice's memory:
+    # peer_restore_s booked, no storage restore time at all
+    pg = p_res.attempt_log[1]["goodput"]
+    assert pg["peer_restore_s"] > 0.0 and pg["restore_s"] == 0.0
+    src, meta = p_sources[1]
+    assert src == "peer"
+    assert meta["step"] == evict_at and meta["from_slice"] == 0
+    assert meta["bytes"] > 0
+    # the cold arm paid storage
+    cg = c_res.attempt_log[1]["goodput"]
+    assert cg["restore_s"] > 0.0 and cg["peer_restore_s"] == 0.0
+    assert c_sources[1][0] == "storage"
+    # bitwise: the hot replica IS the snapshot the storage path wrote —
+    # every post-resume loss matches exactly, including the final one
+    assert p_losses == c_losses
+    assert p_res.metrics["loss"] == c_res.metrics["loss"]
+
+
+# ---------------------------------------------------------------------
+# the goodput chaos drill + its regression fixtures
+# ---------------------------------------------------------------------
+
+G_STEPS = 40
+G_SLEEP = 0.8           # emulated device step time (sleep: load-immune)
+G_CKPT_EVERY = 5
+G_DELAY = 0.05          # emulated storage round-trip per serialize
+ASYNC_FIXTURE = os.path.join(REGRESSIONS, "goodput_chaos_async.json")
+SYNC_FIXTURE = os.path.join(REGRESSIONS, "goodput_chaos_sync.json")
+
+
+def _goodput_worker(ckpt_dir, setup, batches_all, *, async_ckpt,
+                    ckpt_every):
+    cfg, opt, state0, step_fn = setup
+
+    def worker(config):
+        calls = [0]
+
+        def drill_step(st, batch):
+            out = step_fn(st, batch)
+            # the first call per attempt is the loop's compile window —
+            # this drill emulates a warm-cache fleet (PR 4's persistent
+            # compile cache), so only the real (warm) call cost lands
+            # there; every later step sleeps the emulated device time
+            if calls[0]:
+                time.sleep(G_SLEEP)
+            calls[0] += 1
+            return out
+        mgr = CheckpointManager(
+            ckpt_dir, max_to_keep=3, score_attribute=None,
+            async_commit=async_ckpt, storage_delay_s=G_DELAY,
+            peer=PeerReplicator(num_slices=2) if async_ckpt else False)
+        try:
+            final, metrics = run_training(
+                state0, drill_step, lambda epoch: iter(batches_all),
+                epochs=1, ckpt_manager=mgr, ckpt_every=ckpt_every)
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **metrics}
+    return worker
+
+
+def _flatten_goodput(res):
+    g = res.goodput
+    wall = float(g["wall_s"])
+    flat = {"goodput_frac": float(g["goodput_frac"]),
+            "n_attempts": float(res.attempts)}
+    for t in LEDGER_TERMS:
+        flat[f"frac_{t}"] = float(g.get(t, 0.0)) / wall
+    return {k: round(v, 6) for k, v in flat.items()}
+
+
+def _prewarm(scratch, setup, batches_all):
+    """Warm BOTH jit cache entries outside the ledger — the drill
+    measures checkpoint and recovery cost, not compiles (a real fleet
+    absorbs them in PR 4's persistent compile cache, which conftest
+    disables for hermeticity). Two entries exist because an orbax
+    restore hands back arrays COMMITTED to explicit shardings — a
+    different aval than the fresh ``make_train_state`` arrays, so the
+    first resumed attempt would otherwise pay a full XLA compile that
+    the ledger books as its compile window."""
+    cfg, opt, state0, step_fn = setup
+    jax.block_until_ready(step_fn(state0, batches_all[0])[1]["loss"])
+    mgr = CheckpointManager(str(scratch), score_attribute=None,
+                            async_save=False, peer=False)
+    try:
+        mgr.save(1, state0)
+        restored, _ = mgr.restore_if_available(state0)
+    finally:
+        mgr.close()
+    jax.block_until_ready(step_fn(restored, batches_all[0])[1]["loss"])
+
+
+def _run_goodput_arm(root, setup, monkeypatch, *, async_ckpt):
+    """One arm of the chaos drill: G_STEPS sleep-paced steps under a
+    mid-commit kill plus a plain kill (async arm), or the same wall of
+    work under per-step sync saves and a plain kill (baseline)."""
+    batches_all = _wal_batches(G_STEPS)
+    _prewarm(f"{root}_warm", setup, batches_all)
+    if async_ckpt:
+        spec = (f"rank=0:kind=kill_during_commit:step={G_CKPT_EVERY * 4};"
+                f"rank=0:kind=kill:step={G_CKPT_EVERY * 6 + 3}")
+    else:
+        spec = f"rank=0:kind=kill:step={G_CKPT_EVERY * 6 + 3}"
+    monkeypatch.setenv("FAULT_SPEC", spec)
+    res = JaxTrainer(
+        _goodput_worker(str(root), setup, batches_all,
+                        async_ckpt=async_ckpt,
+                        ckpt_every=G_CKPT_EVERY if async_ckpt else 1),
+        use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=2))).fit()
+    assert res.error is None
+    assert res.metrics["final_step"] == G_STEPS
+    return res, _flatten_goodput(res)
+
+
+def _maybe_record(flat, path, source):
+    if os.environ.get("REGRESSION_UPDATE") == "1":
+        write_regression(flat, path, source=source,
+                         tolerances={"goodput_frac": 0.02,
+                                     "n_attempts": 0.0})
+
+
+def test_goodput_chaos_async_peer_meets_target(tmp_path, monkeypatch,
+                                               tiny_train_setup):
+    """THE acceptance number of ISSUE 18: under chaos (a kill mid-
+    commit + a plain kill), async checkpointing + peer replication keep
+    goodput_frac ≥ 0.99 — while the recorded sync baseline, same work
+    and same chaos, sits well below. Pinned as an obs-diff regression
+    fixture so the ratchet holds."""
+    res, flat = _run_goodput_arm(tmp_path / "async", tiny_train_setup,
+                                 monkeypatch, async_ckpt=True)
+    # the chaos actually happened: 3 attempts, torn commit at 20 → the
+    # retry resumed from 15; the plain kill's queued commit drained in
+    # close (a real SIGKILL-after-commit), resuming at 33's floor 30
+    assert res.attempts == 3
+    assert "injected kill during commit of step 20" in \
+        res.attempt_log[0]["error"]
+    assert "injected kill at step 33" in res.attempt_log[1]["error"]
+    # the final attempt resumed from 33's committed floor (30) — the
+    # torn 20 → resume-from-15 contract is pinned step-exactly by
+    # test_kill_during_commit_resumes_previous_step_bitwise; here both
+    # retries paid a (storage) restore and nothing else
+    assert res.attempt_log[2]["resumed_step"] == G_CKPT_EVERY * 6
+    assert res.attempt_log[1]["goodput"]["restore_s"] > 0.0
+    assert res.attempt_log[2]["goodput"]["restore_s"] > 0.0
+    assert res.goodput["eval_ckpt_stall_s"] == 0.0
+    _maybe_record(flat, ASYNC_FIXTURE,
+                  source="tests/test_goodput.py "
+                         "test_goodput_chaos_async_peer_meets_target "
+                         "(REGRESSION_UPDATE=1)")
+    assert flat["goodput_frac"] >= 0.99, flat
+    with open(ASYNC_FIXTURE) as f:
+        recorded = json.load(f)
+    with open(SYNC_FIXTURE) as f:
+        sync_recorded = json.load(f)
+    # the checked-in pair tells the headline story on its own
+    assert recorded["goodput_frac"] >= 0.99
+    assert sync_recorded["goodput_frac"] < 0.92
+    assert flat["goodput_frac"] > sync_recorded["goodput_frac"]
+    viols = diff_flat(flat, recorded)
+    assert not viols, "\n".join(viols)
+
+
+@pytest.mark.slow
+def test_goodput_chaos_sync_baseline_pays_the_stall(tmp_path, monkeypatch,
+                                                    tiny_train_setup):
+    """The baseline arm, live (the tier-1 gate only reads its recorded
+    fixture): per-step sync saves block the loop on every emulated
+    storage round-trip, and the same plain kill costs a storage
+    restore — goodput lands far below the async arm's."""
+    res, flat = _run_goodput_arm(tmp_path / "sync", tiny_train_setup,
+                                 monkeypatch, async_ckpt=False)
+    assert res.attempts == 2
+    assert res.goodput["eval_ckpt_stall_s"] > 0.0
+    _maybe_record(flat, SYNC_FIXTURE,
+                  source="tests/test_goodput.py "
+                         "test_goodput_chaos_sync_baseline_pays_the_stall "
+                         "(REGRESSION_UPDATE=1)")
+    assert flat["goodput_frac"] < 0.95
+    with open(SYNC_FIXTURE) as f:
+        recorded = json.load(f)
+    viols = diff_flat(flat, recorded)
+    assert not viols, "\n".join(viols)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("storage_delay", [0.0, 0.2])
+def test_goodput_chaos_matrix_async_robust_to_storage_speed(
+        tmp_path, monkeypatch, tiny_train_setup, storage_delay):
+    """The exhaustive half of the chaos matrix (slow): the async arm's
+    goodput must hold whether the emulated storage is instant or 4x
+    slower than the tier-1 drill — the commit cost rides the committer
+    thread either way."""
+    batches_all = _wal_batches(G_STEPS)
+    _prewarm(tmp_path / "warm", tiny_train_setup, batches_all)
+    monkeypatch.setenv(
+        "FAULT_SPEC",
+        f"rank=0:kind=kill_during_commit:step={G_CKPT_EVERY * 4}")
+    cfg, opt, state0, step_fn = tiny_train_setup
+
+    def worker(config):
+        calls = [0]
+
+        def drill_step(st, batch):
+            out = step_fn(st, batch)
+            if calls[0]:
+                time.sleep(G_SLEEP)
+            calls[0] += 1
+            return out
+        mgr = CheckpointManager(
+            str(tmp_path / "m"), max_to_keep=3, score_attribute=None,
+            async_commit=True, storage_delay_s=storage_delay)
+        try:
+            final, metrics = run_training(
+                state0, drill_step, lambda epoch: iter(batches_all),
+                epochs=1, ckpt_manager=mgr, ckpt_every=G_CKPT_EVERY)
+        finally:
+            mgr.close()
+        return {"final_step": int(jax.device_get(final.step)), **metrics}
+
+    res = JaxTrainer(
+        worker, use_ray=False,
+        run_config=RunConfig(failure_config=FailureConfig(
+            max_failures=1))).fit()
+    assert res.error is None and res.attempts == 2
+    assert res.attempt_log[1]["resumed_step"] == G_CKPT_EVERY * 3
+    assert _flatten_goodput(res)["goodput_frac"] >= 0.99
